@@ -1,0 +1,21 @@
+from .cnn import (
+    PARAM_SPECS,
+    PARAM_NAMES,
+    accuracy,
+    apply_fn,
+    init_params,
+    loss_fn,
+    num_params,
+    param_sizes,
+)
+
+__all__ = [
+    "PARAM_SPECS",
+    "PARAM_NAMES",
+    "accuracy",
+    "apply_fn",
+    "init_params",
+    "loss_fn",
+    "num_params",
+    "param_sizes",
+]

@@ -1,0 +1,197 @@
+"""The MNIST CNN, re-designed for TPU as a pure-JAX functional model.
+
+Architecture parity with the reference graph (mnist_sync/model/model.py:17-106):
+four 5x5 SAME convs (1->32->64->128->256 channels), each ReLU + 2x2 SAME
+maxpool (spatial 28->14->7->4->2), then FC 1024 (ReLU) -> dropout -> FC 512
+(**no activation**, as in model.py:79) -> dropout -> FC 10 logits; loss is
+mean softmax cross-entropy (model.py:91-92); dropout uses TF semantics
+(keep with prob ``keep_prob``, scale kept values by ``1/keep_prob``,
+model.py:73-82); all 14 variables are glorot-uniform initialized (the
+TF1 ``get_variable`` default).
+
+TPU-first design decisions (not translations):
+- Params are a flat pytree ``{"v0": ..., "v13": ...}`` — the 1:1 analogue of
+  the reference's ``var_bucket`` (model.py:96-98) and the unit of placement
+  for every sharding/layout policy in ``ddl_tpu.parallel``.
+- NHWC layout + ``lax.conv_general_dilated`` / ``lax.reduce_window`` so XLA
+  tiles convs onto the MXU and fuses the bias+ReLU chain; no per-layer
+  ``sess.run`` round-trips (the reference pays 14 Python hops per step,
+  worker.py:35-36 — here the whole step is one compiled program).
+- Optional ``compute_dtype=jnp.bfloat16`` casts activations/weights for the
+  MXU while keeping logits/loss in fp32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# (name, shape) for the 14 trainable variables, in the reference's creation
+# order (mnist_sync/model/model.py:24-86, names v0..v13 per get_variable).
+PARAM_SPECS: tuple[tuple[str, tuple[int, ...]], ...] = (
+    ("v0", (5, 5, 1, 32)),  # w_conv1
+    ("v1", (32,)),  # b_conv1
+    ("v2", (5, 5, 32, 64)),  # w_conv2
+    ("v3", (64,)),  # b_conv2
+    ("v4", (5, 5, 64, 128)),  # w_conv3
+    ("v5", (128,)),  # b_conv3
+    ("v6", (5, 5, 128, 256)),  # w_conv4
+    ("v7", (256,)),  # b_conv4
+    ("v8", (2 * 2 * 256, 1024)),  # w_fc1
+    ("v9", (1024,)),  # b_fc1
+    ("v10", (1024, 512)),  # w_fc2
+    ("v11", (512,)),  # b_fc2
+    ("v12", (512, 10)),  # w_fc3
+    ("v13", (10,)),  # b_fc3
+)
+
+PARAM_NAMES: tuple[str, ...] = tuple(name for name, _ in PARAM_SPECS)
+
+Params = Mapping[str, jax.Array]
+
+
+def param_sizes() -> dict[str, int]:
+    """Element count per variable — the quantity every layout policy
+    balances (cf. greedy ordering over element counts,
+    mnist_sync_sharding_greedy/worker.py:14-16)."""
+    return {name: math.prod(shape) for name, shape in PARAM_SPECS}
+
+
+def num_params() -> int:
+    return sum(param_sizes().values())
+
+
+def _fans(shape: tuple[int, ...]) -> tuple[float, float]:
+    """TF/Keras ``_compute_fans``: rank-1 -> (n, n); rank-2 -> (in, out);
+    rank-4 conv -> receptive field x channels."""
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    receptive = math.prod(shape[:-2])
+    return float(shape[-2] * receptive), float(shape[-1] * receptive)
+
+
+def init_params(key: jax.Array, dtype=jnp.float32) -> dict[str, jax.Array]:
+    """Glorot-uniform init for all 14 vars — the TF1 ``get_variable``
+    default the reference relies on (model.py:24-86 passes no initializer),
+    including for the rank-1 biases."""
+    keys = jax.random.split(key, len(PARAM_SPECS))
+    params = {}
+    for subkey, (name, shape) in zip(keys, PARAM_SPECS):
+        fan_in, fan_out = _fans(shape)
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        params[name] = jax.random.uniform(
+            subkey, shape, dtype=dtype, minval=-limit, maxval=limit
+        )
+    return params
+
+
+def _conv_block(x: jax.Array, w: jax.Array, b: jax.Array, precision) -> jax.Array:
+    """5x5 SAME conv + bias + ReLU + 2x2 SAME maxpool (stride 2), NHWC."""
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision,
+    )
+    y = jax.nn.relu(y + b)
+    return lax.reduce_window(
+        y,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="SAME",
+    )
+
+
+def _dropout(
+    x: jax.Array, rng: jax.Array | None, keep_prob: float | jax.Array
+) -> jax.Array:
+    """TF-semantics dropout (model.py:73-74): keep with prob ``keep_prob``,
+    scale kept values by ``1/keep_prob``. ``rng=None`` means eval mode
+    (the reference feeds keep_prob=1.0 at eval, worker.py:72)."""
+    if rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, keep_prob, x.shape)
+    return jnp.where(keep, x / keep_prob, jnp.zeros_like(x))
+
+
+def apply_fn(
+    params: Params,
+    x: jax.Array,
+    *,
+    dropout_rng: jax.Array | None = None,
+    keep_prob: float = 0.5,
+    compute_dtype=None,
+    precision: lax.Precision | None = None,
+) -> jax.Array:
+    """Forward pass: ``[N, 784]`` -> fp32 logits ``[N, 10]``.
+
+    ``dropout_rng=None`` disables dropout (eval). With a key, the two
+    dropout sites get independent masks, matching the reference's two
+    ``tf.nn.dropout`` calls (model.py:74,82). ``precision=None`` keeps the
+    backend default (MXU-friendly); pass ``lax.Precision.HIGHEST`` for
+    strict fp32 accumulation (used by the parity tests).
+    """
+    if compute_dtype is not None:
+        params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
+        x = x.astype(compute_dtype)
+    h = x.reshape(-1, 28, 28, 1)  # model.py:19
+    h = _conv_block(h, params["v0"], params["v1"], precision)
+    h = _conv_block(h, params["v2"], params["v3"], precision)
+    h = _conv_block(h, params["v4"], params["v5"], precision)
+    h = _conv_block(h, params["v6"], params["v7"], precision)
+    h = h.reshape(h.shape[0], 2 * 2 * 256)  # model.py:69
+    mm = lambda a, b: jnp.matmul(a, b, precision=precision)
+    h = jax.nn.relu(mm(h, params["v8"]) + params["v9"])
+    if dropout_rng is not None:
+        k1, k2 = jax.random.split(dropout_rng)
+    else:
+        k1 = k2 = None
+    h = _dropout(h, k1, keep_prob)
+    h = mm(h, params["v10"]) + params["v11"]  # no activation (model.py:79)
+    h = _dropout(h, k2, keep_prob)
+    logits = mm(h, params["v12"]) + params["v13"]
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    x: jax.Array,
+    y_onehot: jax.Array,
+    *,
+    dropout_rng: jax.Array | None = None,
+    keep_prob: float = 0.5,
+    compute_dtype=None,
+    precision: lax.Precision | None = None,
+) -> jax.Array:
+    """Mean softmax cross-entropy (model.py:91-92)."""
+    logits = apply_fn(
+        params,
+        x,
+        dropout_rng=dropout_rng,
+        keep_prob=keep_prob,
+        compute_dtype=compute_dtype,
+        precision=precision,
+    )
+    logprobs = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(y_onehot * logprobs, axis=-1))
+
+
+def accuracy(params: Params, x: jax.Array, y_onehot: jax.Array) -> jax.Array:
+    """Top-1 accuracy over one-hot labels (model.py:104-105); eval mode
+    (no dropout), as the reference feeds keep_prob=1.0 (worker.py:72)."""
+    logits = apply_fn(params, x, dropout_rng=None)
+    return jnp.mean(
+        (jnp.argmax(logits, axis=-1) == jnp.argmax(y_onehot, axis=-1)).astype(
+            jnp.float32
+        )
+    )

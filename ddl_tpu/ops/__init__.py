@@ -1,0 +1,3 @@
+from .optimizers import AdamState, adam_init, adam_update
+
+__all__ = ["AdamState", "adam_init", "adam_update"]

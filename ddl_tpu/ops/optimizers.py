@@ -1,0 +1,64 @@
+"""Optimizers with reference-exact numerics.
+
+The reference trains every variant with ``tf.compat.v1.train.AdamOptimizer(1e-4)``
+(mnist_sync/model/model.py:93; parameter_server.py:21). TF1 Adam applies
+
+    lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
+    m_t  = b1 * m + (1 - b1) * g
+    v_t  = b2 * v + (1 - b2) * g^2
+    p   -= lr_t * m_t / (sqrt(v_t) + eps)
+
+— note ``eps`` is added *outside* the square root of the **uncorrected**
+second moment, which differs slightly from optax/torch Adam (both use
+``m_hat / (sqrt(v_hat) + eps)``). We implement the TF formulation exactly so
+single-chip training is a bitwise-faithful oracle for the distributed
+strategies, and parity tests against the reference's math are meaningful.
+
+Functional API: state is a pytree, updates are pure — jit/shard_map friendly.
+Because the state mirrors the param pytree structure, any `NamedSharding`
+placed on a param shard applies verbatim to its optimizer state (the ZeRO-1
+property the sharded strategies rely on).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class AdamState(NamedTuple):
+    step: jax.Array  # int32 scalar, number of updates applied
+    m: PyTree  # first moment, same structure as params
+    v: PyTree  # second moment, same structure as params
+
+
+def adam_init(params: PyTree) -> AdamState:
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return AdamState(step=jnp.zeros((), jnp.int32), m=zeros, v=zeros)
+
+
+def adam_update(
+    params: PyTree,
+    state: AdamState,
+    grads: PyTree,
+    *,
+    lr: float = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> tuple[PyTree, AdamState]:
+    """One TF1-semantics Adam step. Returns ``(new_params, new_state)``."""
+    step = state.step + 1
+    t = step.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+
+    new_m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, state.m, grads)
+    new_v = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * g * g, state.v, grads)
+    new_params = jax.tree.map(
+        lambda p, m, v: p - lr_t * m / (jnp.sqrt(v) + eps), params, new_m, new_v
+    )
+    return new_params, AdamState(step=step, m=new_m, v=new_v)

@@ -1,0 +1,4 @@
+from .config import TrainConfig
+from .trainer import SingleChipTrainer, TrainResult
+
+__all__ = ["TrainConfig", "SingleChipTrainer", "TrainResult"]

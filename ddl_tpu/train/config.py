@@ -1,0 +1,66 @@
+"""Configuration system.
+
+The reference hardcodes every knob: ``epoch = 1``, ``batch_size = 100``
+(mnist_sync/worker.py:41-42, parameter_server.py:42-43), Adam LR ``1e-4``
+(model/model.py:93), dropout keep_prob 0.5 train / 1.0 eval
+(worker.py:30,72), eval every 10 batches (worker.py:71), and learns the
+PS/worker split from ``run.sh`` appending ``-np $N`` to argv
+(mnist_sync_sharding/worker.py:65). This dataclass replaces all of that with
+one explicit, serializable config (SURVEY.md section 5 "config/flag system"
+gap-fill).
+
+Compat flags quarantine the reference's accidental semantics (default =
+correct, flag = reproduce):
+
+- ``grad_reduction``: the reference PS *sums* worker gradients without
+  dividing by worker count (mnist_sync/parameter_server.py:36-37), so the
+  effective LR scales with workers. Default ``"mean"``; ``"sum"`` reproduces
+  the reference.
+- ``shard_data``: reference workers all train on the *same* batches — there
+  is no data sharding (worker.py:27-30 slices the full train set identically
+  in every rank); only dropout masks differ. Default ``True`` (proper DP
+  shards); ``False`` reproduces replicated data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    # Reference defaults (worker.py:41-42, model.py:93).
+    epochs: int = 1
+    batch_size: int = 100  # global batch size
+    learning_rate: float = 1e-4
+    keep_prob: float = 0.5
+    eval_every: int = 10  # batches between full-test-set evals (worker.py:71)
+    seed: int = 0
+
+    # Topology (replaces run.sh positional args + MPI rank conventions).
+    num_workers: int = 1  # data-parallel degree (mesh axis size)
+    num_ps: int = 1  # parameter-shard count (sharded strategies)
+
+    # Strategy knobs.
+    layout: Literal["block", "zigzag", "lpt"] = "block"
+    grad_reduction: Literal["mean", "sum"] = "mean"
+    shard_data: bool = True
+
+    # Async-only: deterministic staleness schedule seed (SURVEY.md section 4d).
+    staleness_seed: int = 0
+    # Async-only: max param-staleness (in updates) tolerated before a worker
+    # refreshes; models the Hogwild envelope explicitly instead of racing.
+    max_staleness: int = 4
+
+    # TPU numerics: compute dtype for the forward/backward pass.
+    # None = fp32 (reference parity); "bfloat16" engages the MXU fast path.
+    compute_dtype: str | None = None
+
+    def per_worker_batch(self) -> int:
+        if self.batch_size % self.num_workers:
+            raise ValueError(
+                f"global batch {self.batch_size} not divisible by "
+                f"{self.num_workers} workers"
+            )
+        return self.batch_size // self.num_workers

@@ -1,0 +1,139 @@
+"""Single-chip trainer — parity with the reference's ``single.py``.
+
+The reference baseline (mnist_sync/single.py:10-21) runs sequential
+mini-batches through the graph's own ``train_step``, printing full-test-set
+accuracy every 10 batches and at exit. This trainer reproduces that loop as
+one jit-compiled XLA program per step (grad + Adam fused, no per-variable
+Python round-trips), and is the numerical oracle the distributed strategies
+are tested against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import Dataset, one_hot
+from ..models import cnn
+from ..ops import AdamState, adam_init, adam_update
+from .config import TrainConfig
+
+
+@dataclasses.dataclass
+class TrainResult:
+    params: dict
+    final_accuracy: float
+    wall_time_s: float  # total, including periodic evals (reference-style)
+    train_time_s: float  # step time only, evals excluded
+    history: list[tuple[int, int, float]]  # (epoch, batch, accuracy)
+    images_per_sec: float  # images / train_time_s
+
+
+def make_train_step(
+    config: TrainConfig,
+) -> Callable[[dict, AdamState, jax.Array, jax.Array, jax.Array], tuple[dict, AdamState, jax.Array]]:
+    """Build the jittable single-chip train step:
+    ``(params, opt_state, x, y_onehot, rng) -> (params', opt_state', loss)``."""
+    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+
+    def step(params, opt_state, x, y, rng):
+        loss, grads = jax.value_and_grad(cnn.loss_fn)(
+            params,
+            x,
+            y,
+            dropout_rng=rng,
+            keep_prob=config.keep_prob,
+            compute_dtype=compute_dtype,
+        )
+        params, opt_state = adam_update(
+            params, opt_state, grads, lr=config.learning_rate
+        )
+        return params, opt_state, loss
+
+    return step
+
+
+# Module-level so the jit cache is shared across evaluate() calls.
+_jit_accuracy = jax.jit(cnn.accuracy)
+
+
+def evaluate(
+    params: dict, x_test: jax.Array, y_test_onehot: jax.Array, batch: int = 2000
+) -> float:
+    """Full-test-set accuracy (reference evals all 10k at once,
+    worker.py:72; we batch to bound activation memory at 256-channel
+    feature maps)."""
+    n = x_test.shape[0]
+    correct = 0.0
+    acc_fn = _jit_accuracy
+    for i in range(0, n, batch):
+        xs, ys = x_test[i : i + batch], y_test_onehot[i : i + batch]
+        correct += float(acc_fn(params, xs, ys)) * xs.shape[0]
+    return correct / n
+
+
+class SingleChipTrainer:
+    """`single.py`-equivalent training on one device."""
+
+    def __init__(self, config: TrainConfig, dataset: Dataset):
+        self.config = config
+        self.dataset = dataset
+        self.y_train_onehot = one_hot(dataset.y_train)
+        self.y_test_onehot = one_hot(dataset.y_test)
+        key = jax.random.PRNGKey(config.seed)
+        self.init_key, self.dropout_key = jax.random.split(key)
+        self.params = cnn.init_params(self.init_key)
+        self.opt_state = adam_init(self.params)
+        self._step = jax.jit(make_train_step(config))
+
+    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+        cfg = self.config
+        x_train = jnp.asarray(self.dataset.x_train)
+        y_train = jnp.asarray(self.y_train_onehot)
+        x_test = jnp.asarray(self.dataset.x_test)
+        y_test = jnp.asarray(self.y_test_onehot)
+
+        params, opt_state = self.params, self.opt_state
+        history: list[tuple[int, int, float]] = []
+        batch_num = self.dataset.num_train // cfg.batch_size
+        images = 0
+        train_time = 0.0
+        start = time.perf_counter()
+        segment_start = start
+        for epoch in range(cfg.epochs):
+            for cnt in range(batch_num):
+                # Sequential slicing, no shuffle — reference semantics
+                # (single.py:14-15 slices [bs*cnt : bs*(cnt+1)] in order).
+                lo, hi = cfg.batch_size * cnt, cfg.batch_size * (cnt + 1)
+                rng = jax.random.fold_in(self.dropout_key, epoch * batch_num + cnt)
+                params, opt_state, _ = self._step(
+                    params, opt_state, x_train[lo:hi], y_train[lo:hi], rng
+                )
+                images += cfg.batch_size
+                if cfg.eval_every and cnt % cfg.eval_every == 0:
+                    jax.block_until_ready(params)
+                    train_time += time.perf_counter() - segment_start
+                    acc = evaluate(params, x_test, y_test)
+                    history.append((epoch, cnt, acc))
+                    log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                    segment_start = time.perf_counter()
+        jax.block_until_ready(params)
+        end = time.perf_counter()
+        train_time += end - segment_start
+        wall = end - start
+        final_acc = evaluate(params, x_test, y_test)
+        log(f"final accuracy: {final_acc}")
+        self.params, self.opt_state = params, opt_state
+        return TrainResult(
+            params=jax.tree.map(np.asarray, params),
+            final_accuracy=final_acc,
+            wall_time_s=wall,
+            train_time_s=train_time,
+            history=history,
+            images_per_sec=images / train_time if train_time > 0 else 0.0,
+        )

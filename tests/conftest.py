@@ -1,0 +1,29 @@
+"""Test environment: force an 8-device virtual CPU mesh before JAX loads,
+so every multi-chip strategy is exercised hermetically (SURVEY.md section 4b)."""
+
+import os
+
+# Force CPU even when the environment pins a TPU platform (JAX_PLATFORMS=axon):
+# tests must be hermetic and exercise the 8-device virtual mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from ddl_tpu.data import load_mnist  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_dataset():
+    """A small deterministic procedural dataset shared across tests."""
+    return load_mnist(path=None, synthetic_train=2048, synthetic_test=512, seed=7)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,60 @@
+"""Data pipeline tests: reference pickle-format parity + procedural
+determinism (reference loader semantics: mnist_sync/model/model.py:6-14)."""
+
+import os
+import pickle
+
+import numpy as np
+
+from ddl_tpu.data import load_mnist, one_hot
+from ddl_tpu.data.mnist import synthesize
+
+
+def test_synthetic_shapes_and_ranges(small_dataset):
+    ds = small_dataset
+    assert ds.x_train.shape == (2048, 784)
+    assert ds.x_test.shape == (512, 784)
+    assert ds.x_train.dtype == np.float32
+    assert ds.y_train.dtype == np.int32
+    assert ds.x_train.min() >= 0.0 and ds.x_train.max() <= 1.0
+    assert set(np.unique(ds.y_train)) == set(range(10))
+
+
+def test_synthetic_deterministic():
+    x1, y1 = synthesize(256, seed=42)
+    x2, y2 = synthesize(256, seed=42)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    x3, _ = synthesize(256, seed=43)
+    assert not np.array_equal(x1, x3)
+
+
+def test_class_balance():
+    _, y = synthesize(1000, seed=0)
+    counts = np.bincount(y, minlength=10)
+    assert counts.min() == counts.max() == 100
+
+
+def test_one_hot_matches_get_dummies_semantics():
+    y = np.array([3, 0, 9, 3])
+    oh = one_hot(y)
+    assert oh.shape == (4, 10)
+    assert oh.dtype == np.float32
+    np.testing.assert_array_equal(oh.argmax(axis=1), y)
+    np.testing.assert_array_equal(oh.sum(axis=1), np.ones(4))
+
+
+def test_load_reference_pickle_format(tmp_path):
+    """The 3-way deeplearning.net pickle the reference consumes
+    (model.py:8-11): (train, valid, test); valid is discarded."""
+    xt = np.random.default_rng(0).random((20, 784)).astype(np.float32)
+    yt = np.arange(20) % 10
+    xv = np.zeros((5, 784), np.float32)
+    blob = ((xt, yt), (xv, np.zeros(5, int)), (xt[:10], yt[:10]))
+    path = tmp_path / "mnist.pkl"
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    ds = load_mnist(path=os.fspath(path))
+    np.testing.assert_allclose(ds.x_train, xt)
+    np.testing.assert_array_equal(ds.y_train, yt)
+    assert ds.num_test == 10

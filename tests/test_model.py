@@ -1,0 +1,133 @@
+"""Model math tests against an independent torch oracle.
+
+The reference validates nothing (SURVEY.md section 4); here the JAX CNN's
+forward, loss, and gradients are checked against a from-scratch torch CPU
+implementation of the same architecture (mnist_sync/model/model.py:17-106).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+import torch.nn.functional as F
+
+from ddl_tpu.models import cnn
+
+
+def _torch_forward(params_np, x_np):
+    """Reference-architecture forward in torch (NCHW), from the same
+    weights. Returns logits."""
+    x = torch.from_numpy(x_np).reshape(-1, 28, 28, 1).permute(0, 3, 1, 2)
+
+    def conv_block(h, w, b):
+        # TF 'SAME' for 5x5 stride-1 == pad 2.
+        w_t = torch.from_numpy(w).permute(3, 2, 0, 1)  # HWIO -> OIHW
+        h = F.conv2d(h, w_t, torch.from_numpy(b), padding=2)
+        h = F.relu(h)
+        # TF 'SAME' 2x2/2 maxpool == ceil_mode with edge-clipped windows.
+        return F.max_pool2d(h, 2, 2, ceil_mode=True)
+
+    h = conv_block(x, params_np["v0"], params_np["v1"])
+    h = conv_block(h, params_np["v2"], params_np["v3"])
+    h = conv_block(h, params_np["v4"], params_np["v5"])
+    h = conv_block(h, params_np["v6"], params_np["v7"])
+    # Match JAX NHWC flatten order: [N, 2, 2, 256].
+    h = h.permute(0, 2, 3, 1).reshape(-1, 2 * 2 * 256)
+    h = F.relu(h @ torch.from_numpy(params_np["v8"]) + torch.from_numpy(params_np["v9"]))
+    h = h @ torch.from_numpy(params_np["v10"]) + torch.from_numpy(params_np["v11"])
+    return h @ torch.from_numpy(params_np["v12"]) + torch.from_numpy(params_np["v13"])
+
+
+@pytest.fixture(scope="module")
+def setup():
+    key = jax.random.PRNGKey(0)
+    params = cnn.init_params(key)
+    params_np = {k: np.asarray(v) for k, v in params.items()}
+    x = np.random.default_rng(1).random((8, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
+    return params, params_np, x, y
+
+
+def test_param_specs():
+    sizes = cnn.param_sizes()
+    assert cnn.num_params() == 2_656_010  # SURVEY.md section 2.1 total
+    assert sizes["v8"] == 1_048_576 and sizes["v13"] == 10
+    assert list(cnn.PARAM_NAMES) == [f"v{i}" for i in range(14)]
+
+
+def test_forward_matches_torch(setup):
+    params, params_np, x, _ = setup
+    logits_jax = np.asarray(
+        cnn.apply_fn(params, jnp.asarray(x), precision=jax.lax.Precision.HIGHEST)
+    )
+    logits_torch = _torch_forward(params_np, x).detach().numpy()
+    np.testing.assert_allclose(logits_jax, logits_torch, rtol=1e-4, atol=1e-4)
+
+
+def test_loss_and_grads_match_torch(setup):
+    params, params_np, x, y = setup
+    loss_jax, grads = jax.value_and_grad(cnn.loss_fn)(
+        params,
+        jnp.asarray(x),
+        jnp.asarray(y),
+        dropout_rng=None,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+    tparams = {k: torch.from_numpy(v).requires_grad_(True) for k, v in params_np.items()}
+
+    def forward_with(tp):
+        x_t = torch.from_numpy(x).reshape(-1, 28, 28, 1).permute(0, 3, 1, 2)
+
+        def conv_block(h, w, b):
+            h = F.conv2d(h, w.permute(3, 2, 0, 1), b, padding=2)
+            return F.max_pool2d(F.relu(h), 2, 2, ceil_mode=True)
+
+        h = conv_block(x_t, tp["v0"], tp["v1"])
+        h = conv_block(h, tp["v2"], tp["v3"])
+        h = conv_block(h, tp["v4"], tp["v5"])
+        h = conv_block(h, tp["v6"], tp["v7"])
+        h = h.permute(0, 2, 3, 1).reshape(-1, 1024)
+        h = F.relu(h @ tp["v8"] + tp["v9"])
+        h = h @ tp["v10"] + tp["v11"]
+        logits = h @ tp["v12"] + tp["v13"]
+        logp = F.log_softmax(logits, dim=-1)
+        return -(torch.from_numpy(y) * logp).sum(dim=-1).mean()
+
+    loss_torch = forward_with(tparams)
+    loss_torch.backward()
+    np.testing.assert_allclose(float(loss_jax), float(loss_torch), rtol=1e-4)
+    for name in cnn.PARAM_NAMES:
+        np.testing.assert_allclose(
+            np.asarray(grads[name]),
+            tparams[name].grad.numpy(),
+            rtol=1e-3,
+            atol=1e-5,
+            err_msg=f"grad mismatch for {name}",
+        )
+
+
+def test_dropout_semantics():
+    """TF dropout: kept values scaled by 1/keep_prob; eval = identity."""
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    x = jnp.ones((4, 784))
+    eval_logits = cnn.apply_fn(params, x, dropout_rng=None)
+    eval_logits2 = cnn.apply_fn(params, x, dropout_rng=None)
+    np.testing.assert_array_equal(np.asarray(eval_logits), np.asarray(eval_logits2))
+    # Train mode with different keys differs.
+    l1 = cnn.apply_fn(params, x, dropout_rng=jax.random.PRNGKey(1))
+    l2 = cnn.apply_fn(params, x, dropout_rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(l1), np.asarray(l2))
+    # keep_prob=1.0 with a key == eval exactly.
+    l3 = cnn.apply_fn(params, x, dropout_rng=jax.random.PRNGKey(1), keep_prob=1.0)
+    np.testing.assert_allclose(np.asarray(l3), np.asarray(eval_logits), rtol=1e-6)
+
+
+def test_glorot_init_stats():
+    """Init is glorot-uniform (TF1 get_variable default, model.py:24-86)."""
+    params = cnn.init_params(jax.random.PRNGKey(3))
+    w = np.asarray(params["v8"])  # [1024, 1024]
+    limit = np.sqrt(6.0 / (1024 + 1024))
+    assert np.abs(w).max() <= limit
+    assert w.std() == pytest.approx(limit / np.sqrt(3), rel=0.05)
