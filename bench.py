@@ -1,0 +1,124 @@
+"""Benchmark harness — prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.md north star): MNIST images/sec/chip for the
+sync strategy on real hardware. ``vs_baseline`` compares against a
+torch-CPU implementation of the same CNN + Adam step measured in-process —
+a stand-in for the reference's CPU TensorFlow runtime (the reference
+publishes no numbers, SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_jax(steps: int = 60, batch: int = 200) -> float:
+    """Steady-state images/sec for the jitted train step on the default
+    platform (one real TPU chip under the driver)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.data import one_hot, synthesize
+    from ddl_tpu.models import cnn
+    from ddl_tpu.ops import adam_init
+    from ddl_tpu.train.config import TrainConfig
+    from ddl_tpu.train.trainer import make_train_step
+
+    x, y = synthesize(batch * 4, seed=0)
+    x = jnp.asarray(x)
+    y = jnp.asarray(one_hot(y))
+    cfg = TrainConfig(batch_size=batch, compute_dtype="bfloat16")
+    step = jax.jit(make_train_step(cfg), donate_argnums=(0, 1))
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    opt = adam_init(params)
+    rng = jax.random.PRNGKey(1)
+
+    # Warmup / compile.
+    for i in range(3):
+        lo = (i % 4) * batch
+        params, opt, _ = step(params, opt, x[lo : lo + batch], y[lo : lo + batch],
+                              jax.random.fold_in(rng, i))
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        lo = (i % 4) * batch
+        params, opt, _ = step(params, opt, x[lo : lo + batch], y[lo : lo + batch],
+                              jax.random.fold_in(rng, i))
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def bench_torch_cpu(steps: int = 8, batch: int = 200) -> float:
+    """The comparison baseline: same CNN architecture + Adam on torch CPU
+    (proxy for the reference's CPU TF1 runtime)."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, (torch.get_num_threads())))
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(1, 32, 5, padding=2)
+            self.c2 = nn.Conv2d(32, 64, 5, padding=2)
+            self.c3 = nn.Conv2d(64, 128, 5, padding=2)
+            self.c4 = nn.Conv2d(128, 256, 5, padding=2)
+            self.f1 = nn.Linear(1024, 1024)
+            self.f2 = nn.Linear(1024, 512)
+            self.f3 = nn.Linear(512, 10)
+
+        def forward(self, x):
+            x = x.view(-1, 1, 28, 28)
+            for c in (self.c1, self.c2, self.c3, self.c4):
+                x = F.max_pool2d(F.relu(c(x)), 2, ceil_mode=True)
+            x = x.flatten(1)
+            x = F.dropout(F.relu(self.f1(x)), 0.5, training=True)
+            x = F.dropout(self.f2(x), 0.5, training=True)
+            return self.f3(x)
+
+    net = Net()
+    optim = torch.optim.Adam(net.parameters(), lr=1e-4)
+    x = torch.randn(batch, 784)
+    yi = torch.randint(0, 10, (batch,))
+
+    # Warmup.
+    for _ in range(2):
+        optim.zero_grad()
+        F.cross_entropy(net(x), yi).backward()
+        optim.step()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        optim.zero_grad()
+        F.cross_entropy(net(x), yi).backward()
+        optim.step()
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main() -> None:
+    jax_ips = bench_jax()
+    try:
+        torch_ips = bench_torch_cpu()
+        vs = round(jax_ips / torch_ips, 2)
+    except Exception:
+        vs = None  # baseline unavailable — never fabricate 1.0x parity
+    print(json.dumps({
+        "metric": "mnist_sync_images_per_sec_per_chip",
+        "value": round(jax_ips, 1),
+        "unit": "images/s",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Scaling benchmark: images/sec for each strategy at 1..N devices.
+
+Feeds BASELINE.md (target: sync_sharding >= 70% linear scaling 1->8 chips).
+On the CPU virtual mesh this measures *algorithmic* overhead (collective
+count, serve-loop cost), not ICI bandwidth — TPU numbers come from running
+the same script on real hardware.
+
+Usage:
+    python benchmarks/scaling.py [--devices 8] [--steps 30] [--batch 800]
+                                 [--cpu] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def force_cpu(n: int) -> None:
+    import jax
+
+    try:
+        if len(jax.devices()) >= n and jax.devices()[0].platform == "cpu":
+            return
+    except RuntimeError:
+        pass
+    import jax.extend.backend as jeb
+
+    jeb.clear_backends()
+    jax.config.update("jax_num_cpu_devices", max(n, 8))
+    jax.config.update("jax_platforms", "cpu")
+
+
+def bench_strategy(variant: str, workers: int, steps: int, batch: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl_tpu.data import one_hot, synthesize
+    from ddl_tpu.parallel.mesh import DP_AXIS, make_mesh
+    from ddl_tpu.train.config import TrainConfig
+
+    mesh = make_mesh(workers)
+    x_np, y_np = synthesize(batch, seed=0)
+    y_np = one_hot(y_np)
+    cfg = TrainConfig(
+        num_workers=workers,
+        batch_size=batch,
+        keep_prob=1.0,
+        num_ps=workers if "shard" in variant else 1,
+        layout="flat" if variant == "sharded_flat" else
+               ("zigzag" if "greedy" in variant else "block"),
+    )
+    from ddl_tpu.models import cnn
+
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+
+    if variant.startswith("async"):
+        from ddl_tpu.strategies.async_ps import (
+            async_schedule, async_state_init, make_async_round,
+        )
+        from ddl_tpu.strategies.sync import resolve_layout
+
+        layout = resolve_layout(cfg, workers)
+        state = async_state_init(cfg, mesh, layout, params)
+        run = make_async_round(cfg, mesh, layout)
+        R = 4  # rounds per call
+        per = batch // workers
+        xs = jnp.asarray(x_np.reshape(1, workers, per, -1).repeat(R, 0))
+        ys = jnp.asarray(y_np.reshape(1, workers, per, -1).repeat(R, 0))
+        rngs = jnp.stack([jax.random.fold_in(rng, r) for r in range(R)])
+        scheds = jnp.asarray(async_schedule(0, workers, R))
+        state, ps, _ = run(state, xs, ys, rngs, scheds)  # compile
+        jax.block_until_ready(ps)
+        t0 = time.perf_counter()
+        calls = max(1, steps // R)
+        for _ in range(calls):
+            state, ps, _ = run(state, xs, ys, rngs, scheds)
+        jax.block_until_ready(ps)
+        dt = time.perf_counter() - t0
+        return calls * R * batch / dt
+
+    from ddl_tpu.strategies.sync import (
+        make_dp_step, make_sharded_step, resolve_layout, sharded_adam_init,
+    )
+    from ddl_tpu.ops import adam_init
+
+    data_sh = NamedSharding(mesh, P(DP_AXIS))
+    x = jax.device_put(jnp.asarray(x_np), data_sh)
+    y = jax.device_put(jnp.asarray(y_np), data_sh)
+    layout = resolve_layout(cfg, workers)
+    if layout is None:
+        step = make_dp_step(cfg, mesh)
+        opt = jax.device_put(adam_init(params), NamedSharding(mesh, P()))
+    else:
+        step = make_sharded_step(cfg, mesh, layout)
+        opt = sharded_adam_init(mesh, layout)
+    p = jax.device_put(params, NamedSharding(mesh, P()))
+    p, opt, _ = step(p, opt, x, y, rng)  # compile
+    jax.block_until_ready(p)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, opt, _ = step(p, opt, x, y, jax.random.fold_in(rng, i))
+    jax.block_until_ready(p)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=800)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU mesh (default: use whatever "
+                         "platform is active, CPU-forcing only if too few "
+                         "devices)")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        force_cpu(args.devices)
+    else:
+        try:
+            n = len(jax.devices())
+        except RuntimeError:
+            n = 0
+        if n < args.devices:
+            force_cpu(args.devices)
+
+    results: dict[str, dict[int, float]] = {}
+    widths = [w for w in (1, 2, 4, 8) if w <= args.devices]
+    for variant in ("sync_dp", "sharded_flat", "sharded_greedy", "async"):
+        results[variant] = {}
+        for w in widths:
+            if variant != "sync_dp" and w == 1:
+                continue
+            ips = bench_strategy(variant, w, args.steps, args.batch)
+            results[variant][w] = round(ips, 1)
+            print(f"{variant:15s} W={w}: {ips:10.1f} img/s", flush=True)
+
+    base = results["sync_dp"][1]
+    for variant, per_w in results.items():
+        for w, ips in per_w.items():
+            eff = ips / (base * w)
+            print(f"{variant:15s} W={w}: scaling efficiency {eff:5.1%}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"platform": jax.devices()[0].platform,
+                       "batch": args.batch, "results": results}, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""Command-line launcher — the TPU replacement for the reference's six
+``run.sh`` scripts (reference: mnist_sync/run.sh:3 expands
+``mpiexec -n $1 parameter_server.py : -n $2 worker.py``; SURVEY.md §1
+"launcher layer").
+
+One process drives all chips (JAX single-controller) — there is no MPMD
+role split; the PS/worker topology becomes a strategy config:
+
+    python -m ddl_tpu single
+    python -m ddl_tpu sync                  --num-workers 8
+    python -m ddl_tpu async                 --num-workers 8
+    python -m ddl_tpu sync_sharding         --num-ps 4 --num-workers 8
+    python -m ddl_tpu async_sharding        --num-ps 4 --num-workers 8
+    python -m ddl_tpu sync_sharding_greedy  --num-ps 4 --num-workers 8
+    python -m ddl_tpu async_sharding_greedy --num-ps 4 --num-workers 8
+
+The reference invocation ``run.sh <num_ps> <num_workers>`` maps to
+``--num-ps <num_ps> --num-workers <num_workers>``. Extra capabilities the
+reference hardcodes are flags here (epochs, batch size, LR, layout policy,
+compat switches — see ddl_tpu.train.config.TrainConfig).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+VARIANTS = (
+    "single",
+    "sync",
+    "async",
+    "sync_sharding",
+    "async_sharding",
+    "sync_sharding_greedy",
+    "async_sharding_greedy",
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddl_tpu",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("variant", choices=VARIANTS)
+    p.add_argument("--num-workers", type=int, default=None,
+                   help="data-parallel degree (default: all devices)")
+    p.add_argument("--num-ps", type=int, default=2,
+                   help="parameter shard count for *_sharding variants "
+                        "(reference run.sh arg $1)")
+    p.add_argument("--layout", default=None,
+                   choices=["block", "zigzag", "lpt", "flat"],
+                   help="shard layout policy (default: block for *_sharding, "
+                        "zigzag for *_greedy; '--layout flat --num-ps "
+                        "<num-workers>' is the TPU-native ZeRO-1 fast path)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--keep-prob", type=float, default=0.5)
+    p.add_argument("--eval-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--staleness-seed", type=int, default=0)
+    p.add_argument("--data", default="data/mnist.pkl",
+                   help="mnist.pkl path; synthesized procedurally if absent")
+    p.add_argument("--synthetic-train", type=int, default=50_000,
+                   help="procedural train-set size when --data is absent")
+    p.add_argument("--synthetic-test", type=int, default=10_000,
+                   help="procedural test-set size when --data is absent")
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute (MXU fast path)")
+    p.add_argument("--reference-compat", action="store_true",
+                   help="reproduce the reference's accidental semantics: "
+                        "summed (not averaged) gradients and identical "
+                        "batches on every worker")
+    p.add_argument("--json", action="store_true",
+                   help="emit a single JSON result line at exit")
+    return p
+
+
+def config_from_args(args) -> "TrainConfig":
+    from .train.config import TrainConfig
+
+    sharded = "sharding" in args.variant
+    layout = args.layout
+    if layout is None:
+        layout = "zigzag" if args.variant.endswith("greedy") else "block"
+    return TrainConfig(
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        learning_rate=args.lr,
+        keep_prob=args.keep_prob,
+        eval_every=args.eval_every,
+        seed=args.seed,
+        num_workers=args.num_workers or _default_workers(args.variant),
+        num_ps=args.num_ps if sharded else 1,
+        layout=layout,
+        grad_reduction="sum" if args.reference_compat else "mean",
+        shard_data=not args.reference_compat,
+        staleness_seed=args.staleness_seed,
+        compute_dtype="bfloat16" if args.bf16 else None,
+    )
+
+
+def _default_workers(variant: str) -> int:
+    if variant == "single":
+        return 1
+    import jax
+
+    return len(jax.devices())
+
+
+def _ensure_devices(n: int) -> None:
+    """If the active platform has fewer than ``n`` devices (e.g. one real
+    TPU chip), fall back to a virtual n-device CPU mesh so every strategy
+    is runnable anywhere."""
+    import jax
+
+    try:
+        if len(jax.devices()) >= n:
+            return
+    except RuntimeError:
+        pass
+    import jax.extend.backend as jeb
+
+    jeb.clear_backends()
+    jax.config.update("jax_num_cpu_devices", max(n, 8))
+    jax.config.update("jax_platforms", "cpu")
+    print(f"[ddl_tpu] falling back to {len(jax.devices())}-device virtual CPU mesh")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from .data import load_mnist
+
+    dataset = load_mnist(
+        path=args.data,
+        synthetic_train=args.synthetic_train,
+        synthetic_test=args.synthetic_test,
+    )
+    cfg = config_from_args(args)
+    if args.variant != "single":
+        _ensure_devices(cfg.num_workers)
+
+    if args.variant == "single":
+        from .train.trainer import SingleChipTrainer
+
+        trainer = SingleChipTrainer(cfg, dataset)
+    elif args.variant.startswith("sync"):
+        from .strategies.sync import SyncTrainer
+
+        trainer = SyncTrainer(cfg, dataset)
+    else:
+        from .strategies.async_ps import AsyncTrainer
+
+        trainer = AsyncTrainer(cfg, dataset)
+
+    result = trainer.train()
+    print(f"training time: {result.train_time_s:.2f}s "
+          f"({result.images_per_sec:.0f} images/s)")
+    if args.json:
+        print(json.dumps({
+            "variant": args.variant,
+            "config": dataclasses.asdict(cfg),
+            "final_accuracy": result.final_accuracy,
+            "train_time_s": result.train_time_s,
+            "images_per_sec": result.images_per_sec,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,3 +1,3 @@
-from .mnist import Dataset, load_mnist, one_hot
+from .mnist import Dataset, load_mnist, one_hot, synthesize
 
-__all__ = ["Dataset", "load_mnist", "one_hot"]
+__all__ = ["Dataset", "load_mnist", "one_hot", "synthesize"]

@@ -30,39 +30,62 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# (name, shape) for the 14 trainable variables, in the reference's creation
-# order (mnist_sync/model/model.py:24-86, names v0..v13 per get_variable).
-PARAM_SPECS: tuple[tuple[str, tuple[int, ...]], ...] = (
-    ("v0", (5, 5, 1, 32)),  # w_conv1
-    ("v1", (32,)),  # b_conv1
-    ("v2", (5, 5, 32, 64)),  # w_conv2
-    ("v3", (64,)),  # b_conv2
-    ("v4", (5, 5, 64, 128)),  # w_conv3
-    ("v5", (128,)),  # b_conv3
-    ("v6", (5, 5, 128, 256)),  # w_conv4
-    ("v7", (256,)),  # b_conv4
-    ("v8", (2 * 2 * 256, 1024)),  # w_fc1
-    ("v9", (1024,)),  # b_fc1
-    ("v10", (1024, 512)),  # w_fc2
-    ("v11", (512,)),  # b_fc2
-    ("v12", (512, 10)),  # w_fc3
-    ("v13", (10,)),  # b_fc3
-)
+Specs = tuple[tuple[str, tuple[int, ...]], ...]
+
+
+def make_param_specs(
+    conv_channels: tuple[int, int, int, int] = (32, 64, 128, 256),
+    fc_sizes: tuple[int, int] = (1024, 512),
+    num_classes: int = 10,
+) -> Specs:
+    """(name, shape) for the 14 trainable variables of the architecture
+    family, in the reference's creation order (mnist_sync/model/model.py:24-86,
+    names v0..v13 per get_variable). The defaults reproduce the reference
+    exactly; narrower widths give a structurally-identical model for cheap
+    tests (4 conv+pool stages: spatial 28->14->7->4->2)."""
+    c1, c2, c3, c4 = conv_channels
+    f1, f2 = fc_sizes
+    return (
+        ("v0", (5, 5, 1, c1)),  # w_conv1
+        ("v1", (c1,)),  # b_conv1
+        ("v2", (5, 5, c1, c2)),  # w_conv2
+        ("v3", (c2,)),  # b_conv2
+        ("v4", (5, 5, c2, c3)),  # w_conv3
+        ("v5", (c3,)),  # b_conv3
+        ("v6", (5, 5, c3, c4)),  # w_conv4
+        ("v7", (c4,)),  # b_conv4
+        ("v8", (2 * 2 * c4, f1)),  # w_fc1
+        ("v9", (f1,)),  # b_fc1
+        ("v10", (f1, f2)),  # w_fc2
+        ("v11", (f2,)),  # b_fc2
+        ("v12", (f2, num_classes)),  # w_fc3
+        ("v13", (num_classes,)),  # b_fc3
+    )
+
+
+# The reference model (SURVEY.md §2.1: 2,656,010 params).
+PARAM_SPECS: Specs = make_param_specs()
 
 PARAM_NAMES: tuple[str, ...] = tuple(name for name, _ in PARAM_SPECS)
 
 Params = Mapping[str, jax.Array]
 
 
-def param_sizes() -> dict[str, int]:
+def param_sizes(specs: Specs = PARAM_SPECS) -> dict[str, int]:
     """Element count per variable — the quantity every layout policy
     balances (cf. greedy ordering over element counts,
     mnist_sync_sharding_greedy/worker.py:14-16)."""
-    return {name: math.prod(shape) for name, shape in PARAM_SPECS}
+    return {name: math.prod(shape) for name, shape in specs}
 
 
-def num_params() -> int:
-    return sum(param_sizes().values())
+def num_params(specs: Specs = PARAM_SPECS) -> int:
+    return sum(param_sizes(specs).values())
+
+
+def param_shapes(params: Params) -> dict[str, tuple[int, ...]]:
+    """Static shapes of a concrete param pytree (the runtime analogue of the
+    reference's metadata handshake dict, mnist_sync/worker.py:50)."""
+    return {k: tuple(v.shape) for k, v in params.items()}
 
 
 def _fans(shape: tuple[int, ...]) -> tuple[float, float]:
@@ -76,13 +99,15 @@ def _fans(shape: tuple[int, ...]) -> tuple[float, float]:
     return float(shape[-2] * receptive), float(shape[-1] * receptive)
 
 
-def init_params(key: jax.Array, dtype=jnp.float32) -> dict[str, jax.Array]:
+def init_params(
+    key: jax.Array, dtype=jnp.float32, specs: Specs = PARAM_SPECS
+) -> dict[str, jax.Array]:
     """Glorot-uniform init for all 14 vars — the TF1 ``get_variable``
     default the reference relies on (model.py:24-86 passes no initializer),
     including for the rank-1 biases."""
-    keys = jax.random.split(key, len(PARAM_SPECS))
+    keys = jax.random.split(key, len(specs))
     params = {}
-    for subkey, (name, shape) in zip(keys, PARAM_SPECS):
+    for subkey, (name, shape) in zip(keys, specs):
         fan_in, fan_out = _fans(shape)
         limit = math.sqrt(6.0 / (fan_in + fan_out))
         params[name] = jax.random.uniform(
@@ -149,7 +174,7 @@ def apply_fn(
     h = _conv_block(h, params["v2"], params["v3"], precision)
     h = _conv_block(h, params["v4"], params["v5"], precision)
     h = _conv_block(h, params["v6"], params["v7"], precision)
-    h = h.reshape(h.shape[0], 2 * 2 * 256)  # model.py:69
+    h = h.reshape(h.shape[0], params["v8"].shape[0])  # model.py:69 (2*2*c4)
     mm = lambda a, b: jnp.matmul(a, b, precision=precision)
     h = jax.nn.relu(mm(h, params["v8"]) + params["v9"])
     if dropout_rng is not None:

@@ -1,0 +1,18 @@
+"""Parallelism layer: mesh construction, parameter layout policies, and the
+collective-communication primitives that replace the reference's mpi4py
+transport (SURVEY.md §1 "transport layer", §5 "communication backend")."""
+
+from .layout import (  # noqa: F401
+    LayoutAssignment,
+    assign_layout,
+    block_order,
+    lpt_order,
+    zigzag_order,
+)
+from .mesh import make_mesh  # noqa: F401
+from .collectives import (  # noqa: F401
+    FlatSpec,
+    flatten_params,
+    reassembly_index,
+    unflatten_params,
+)

@@ -1,0 +1,190 @@
+"""Parameter layout policies: which shard owns which parameter.
+
+The reference's sharded parameter servers use the mechanism "permute the
+variable list, then block-partition it by *variable count*":
+
+- **block**: identity permutation; PS ``r`` owns the contiguous variable
+  block ``[L*r, L*(r+1))`` with ``L = num_vars // num_ps`` and the last PS
+  absorbing the remainder (reference:
+  mnist_sync_sharding/parameter_server.py:30-32, worker routing
+  ``ind = i // avg_var_size`` at mnist_sync_sharding/worker.py:33-36).
+- **zigzag** ("greedy" in the reference): sort variables by element count and
+  interleave smallest/largest before block-partitioning, so each block pairs
+  a big tensor with small ones (reference:
+  mnist_sync_sharding_greedy/worker.py:14-30).
+
+This module reproduces both as *policies over (name, size) lists* — no MPI
+ranks, no TF variables — and generalizes them:
+
+- **lpt**: true greedy bin-packing (Longest Processing Time): place each
+  variable, largest first, on the least-loaded shard. Strictly better balance
+  than zigzag at any shard count (SURVEY.md §2.2 notes zigzag is *worse* than
+  naive at 2 shards).
+- **flat**: element-granular equal split that ignores variable boundaries —
+  the TPU-native default (classic ZeRO-1): every shard gets exactly
+  ``ceil(total/S)`` elements, perfect balance by construction, and the update
+  maps onto ``psum_scatter``/``all_gather`` with no padding waste beyond the
+  final shard.
+
+All outputs are static Python/numpy — computed once at trace time, baked into
+the compiled program (the TPU analogue of the reference's runtime metadata
+handshake, mnist_sync_sharding/worker.py:72-75).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+Policy = str  # "block" | "zigzag" | "lpt" | "flat"
+
+POLICIES = ("block", "zigzag", "lpt", "flat")
+
+
+def block_order(names: list[str], sizes: dict[str, int]) -> list[str]:
+    """Identity permutation (reference creation order)."""
+    return list(names)
+
+
+def zigzag_order(names: list[str], sizes: dict[str, int]) -> list[str]:
+    """Sort by element count (stable), then interleave smallest/largest —
+    the reference's greedy ordering (mnist_sync_sharding_greedy/worker.py:14-30).
+    For the 14-var CNN this yields
+    [v13, v8, v1, v6, v3, v10, v5, v4, v7, v2, v11, v12, v0, v9]
+    (SURVEY.md §2.2)."""
+    asc = sorted(names, key=lambda n: sizes[n])
+    desc = asc[::-1]
+    out: list[str] = []
+    for a, d in zip(asc, desc):
+        out.append(a)
+        out.append(d)
+    return out[: len(names)]
+
+
+def lpt_order(
+    names: list[str], sizes: dict[str, int], num_shards: int
+) -> tuple[list[str], list[int]]:
+    """Longest-Processing-Time bin packing.
+
+    Returns ``(order, shard_var_counts)`` where ``order`` lists the variables
+    grouped by owning shard (shard 0's vars first) so that a contiguous
+    block partition with the given per-shard counts realizes the assignment.
+    """
+    loads = [0] * num_shards
+    bins: list[list[str]] = [[] for _ in range(num_shards)]
+    for n in sorted(names, key=lambda n: -sizes[n]):
+        s = int(np.argmin(loads))
+        loads[s] += sizes[n]
+        bins[s].append(n)
+    order = [n for b in bins for n in b]
+    return order, [len(b) for b in bins]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutAssignment:
+    """A fully-resolved layout: permutation + shard ownership.
+
+    The flat parameter vector is the concatenation of variables in ``order``.
+    Shard ``s`` owns flat elements ``[shard_starts[s], shard_starts[s] +
+    shard_sizes[s])``. For var-granular policies these boundaries are
+    variable-aligned; for ``flat`` they are arbitrary equal splits.
+    """
+
+    policy: Policy
+    num_shards: int
+    order: tuple[str, ...]  # variable names, layout order
+    var_offsets: dict[str, int]  # flat offset of each var (layout order)
+    shard_starts: tuple[int, ...]  # [S] flat element offsets
+    shard_sizes: tuple[int, ...]  # [S] owned element counts
+    var_to_shard: dict[str, int] | None  # None for "flat" (vars may span)
+    total: int  # total element count (unpadded)
+
+    @property
+    def max_shard(self) -> int:
+        return max(self.shard_sizes)
+
+    @property
+    def balance(self) -> float:
+        """max/mean shard load — 1.0 is perfect."""
+        return self.max_shard / (self.total / self.num_shards)
+
+    def summary(self) -> str:
+        return (
+            f"layout={self.policy} shards={self.num_shards} "
+            f"sizes={list(self.shard_sizes)} balance={self.balance:.3f}"
+        )
+
+
+def _block_counts(num_vars: int, num_shards: int) -> list[int]:
+    """Reference block split: ``L = num_vars // num_shards`` vars per shard,
+    last shard takes the remainder (parameter_server.py:30-32)."""
+    L = num_vars // num_shards
+    counts = [L] * num_shards
+    counts[-1] += num_vars - L * num_shards
+    return counts
+
+
+def assign_layout(
+    policy: Policy,
+    num_shards: int,
+    names: list[str],
+    sizes: dict[str, int],
+) -> LayoutAssignment:
+    """Resolve a layout policy to a concrete shard assignment."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    total = sum(sizes[n] for n in names)
+
+    if policy == "flat":
+        order = list(names)
+        chunk = -(-total // num_shards)  # ceil: equal padded shards
+        starts = [min(s * chunk, total) for s in range(num_shards)]
+        sz = [min(chunk, total - st) for st in starts]
+        var_to_shard = None
+    else:
+        if policy == "block":
+            order = block_order(names, sizes)
+            counts = _block_counts(len(names), num_shards)
+        elif policy == "zigzag":
+            order = zigzag_order(names, sizes)
+            counts = _block_counts(len(names), num_shards)
+        elif policy == "lpt":
+            order, counts = lpt_order(names, sizes, num_shards)
+        else:
+            raise ValueError(f"unknown layout policy {policy!r}; want {POLICIES}")
+        if num_shards > len(names):
+            raise ValueError(
+                f"{policy!r} layout needs num_shards <= num_vars "
+                f"({num_shards} > {len(names)}); use policy='flat'"
+            )
+        var_to_shard = {}
+        starts, sz = [], []
+        i = 0
+        offset = 0
+        for s, c in enumerate(counts):
+            starts.append(offset)
+            block = order[i : i + c]
+            for n in block:
+                var_to_shard[n] = s
+            size_s = sum(sizes[n] for n in block)
+            sz.append(size_s)
+            offset += size_s
+            i += c
+
+    var_offsets = {}
+    off = 0
+    for n in order:
+        var_offsets[n] = off
+        off += sizes[n]
+
+    return LayoutAssignment(
+        policy=policy,
+        num_shards=num_shards,
+        order=tuple(order),
+        var_offsets=var_offsets,
+        shard_starts=tuple(starts),
+        shard_sizes=tuple(sz),
+        var_to_shard=var_to_shard,
+        total=total,
+    )

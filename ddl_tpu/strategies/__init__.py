@@ -1,0 +1,16 @@
+"""Training strategies: the reference's six variant directories re-designed
+as strategy configs over one codebase (SURVEY.md §7 design stance).
+
+| reference dir                  | strategy here                                |
+|--------------------------------|----------------------------------------------|
+| mnist_sync                     | ``SyncTrainer`` (num_ps=1: pure DP, psum)    |
+| mnist_sync_sharding            | ``SyncTrainer`` + layout="block"             |
+| mnist_sync_sharding_greedy     | ``SyncTrainer`` + layout="zigzag" (or "lpt") |
+| mnist_async                    | ``AsyncTrainer`` (num_ps=1: replicated serve)|
+| mnist_async_sharding           | ``AsyncTrainer`` + layout="block"            |
+| mnist_async_sharding_greedy    | ``AsyncTrainer`` + layout="zigzag"/"lpt"     |
+| */single.py                    | ``ddl_tpu.train.SingleChipTrainer``          |
+"""
+
+from .sync import SyncTrainer, make_dp_step, make_sharded_step  # noqa: F401
+from .async_ps import AsyncTrainer, make_async_round, async_schedule  # noqa: F401

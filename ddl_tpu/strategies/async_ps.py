@@ -1,0 +1,429 @@
+"""Asynchronous parameter-server strategies (Hogwild-style staleness).
+
+Reference semantics (mnist_async*, SURVEY.md §3.4): each worker pushes its
+grads whenever it finishes a batch; the PS applies Adam *immediately* per
+push (no cross-worker barrier) and replies with fresh params only to that
+worker. Workers therefore compute gradients against stale params — staleness
+bounded by the number of interleaved pushes. The reference's ordering is
+nondeterministic (MPI ANY_SOURCE arrival races, including a real
+grad-blending race at mnist_async/parameter_server.py:57-58); here the
+arrival order is an explicit **seeded schedule**, making async training
+deterministic and testable (SURVEY.md §4d) while preserving the staleness
+semantics.
+
+TPU-native design — async on a synchronous-collective machine (SURVEY.md §7
+hard part a): a **round** is one compiled SPMD program over the mesh:
+
+1. *Island phase* (parallel): every device computes gradients against its own
+   stale worker replica — W independent "trainer islands" in one shard_map.
+2. *Serve phase* (compiled Hogwild loop): the W pushes are applied
+   sequentially in schedule order with per-push Adam steps (a ``lax.scan``);
+   worker ``w``'s replica refreshes right after its own push, exactly like
+   the reference's Send-back-to-source (mnist_async/parameter_server.py:67-69).
+
+Two serve placements:
+
+- **replicated** (``mnist_async`` parity, num_ps=1): every device runs the
+  identical serve scan on the full flat vector — "one PS", replicated for
+  free since the compute is deterministic. No gather of params needed; only
+  grads are all-gathered.
+- **sharded** (``mnist_async_sharding[_greedy]`` parity): the serve state
+  (params + Adam m/v) is sharded along the mesh axis per the layout policy;
+  gradients are exchanged with a single ``all_to_all`` (each worker scatters
+  its grad slices to the owning shards), each shard serves the schedule on
+  its slice, and a second ``all_to_all`` returns each worker's refreshed
+  replica. Because Adam is elementwise, sharded serve is bit-identical to
+  replicated serve under the same schedule — a property the tests pin.
+
+Whole epochs run as ``lax.scan`` over rounds inside one jit; the host only
+feeds data chunks and evals at the reference's cadence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data import Dataset, one_hot
+from ..models import cnn
+from ..parallel import collectives as coll
+from ..parallel.layout import LayoutAssignment
+from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
+from ..train.config import TrainConfig
+from ..train.trainer import TrainResult, evaluate
+from ..parallel.layout import assign_layout
+from .sync import resolve_layout
+
+
+def _flat_spec(
+    layout: LayoutAssignment | None,
+    shapes: dict[str, tuple[int, ...]] | None = None,
+) -> coll.FlatSpec:
+    """FlatSpec in the layout's order, or creation order when unsharded.
+    ``shapes`` defaults to the flagship CNN's variable table."""
+    if shapes is None:
+        shapes = dict(cnn.PARAM_SPECS)
+    if layout is None:
+        import math
+
+        sizes = {k: math.prod(s) if s else 1 for k, s in shapes.items()}
+        layout = assign_layout("flat", 1, list(shapes), sizes)
+    return coll.FlatSpec.from_layout(layout, shapes)
+
+
+def async_schedule(seed: int, num_workers: int, rounds: int) -> np.ndarray:
+    """Deterministic arrival order: ``[rounds, W]`` int32, each row a seeded
+    permutation of worker ids — the schedule that replaces the reference's
+    ANY_SOURCE arrival race (mnist_async/parameter_server.py:57-58)."""
+    rng = np.random.default_rng(np.random.PCG64(seed))
+    return np.stack(
+        [rng.permutation(num_workers).astype(np.int32) for _ in range(rounds)]
+    )
+
+
+def _adam_push(p, m, v, t, g, *, lr, b1=0.9, b2=0.999, eps=1e-8):
+    """One per-push TF1-semantics Adam step on flat arrays (the async PS
+    applies each worker's raw gradient as its own step,
+    mnist_async/parameter_server.py:34-35)."""
+    t = t + 1
+    tf_ = t.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1.0 - b2**tf_) / (1.0 - b1**tf_)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    return p - lr_t * m / (jnp.sqrt(v) + eps), m, v, t
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AsyncState:
+    """Carry for the async scan. ``ps``/``m``/``v`` are the flat PS state —
+    full vectors (replicated serve) or per-device chunks laid out
+    ``[W * chunk]`` with ``P(DP_AXIS)`` (sharded serve). ``workers`` holds
+    the stale per-worker replicas ``[W, total]`` (replicated serve) or each
+    worker's own row, sharded ``P(DP_AXIS)``. ``t`` is the global update
+    counter (int32, replicated)."""
+
+    ps: jax.Array
+    m: jax.Array
+    v: jax.Array
+    workers: jax.Array
+    t: jax.Array
+
+
+def make_async_round(
+    config: TrainConfig,
+    mesh: Mesh,
+    layout: LayoutAssignment | None,
+    shapes: dict[str, tuple[int, ...]] | None = None,
+) -> Callable:
+    """Build the jitted multi-round async program.
+
+    Returns ``run(state, xs, ys, rngs, scheds) -> (state, ps_full, loss)``
+    where ``xs``/``ys`` are ``[R, W, bs, ...]`` batches (R rounds), ``rngs``
+    ``[R]`` dropout keys, ``scheds`` ``[R, W]`` arrival orders, and
+    ``ps_full`` is the authoritative flat param vector after the last round
+    (for eval).
+    """
+    W = mesh.devices.size
+    spec = _flat_spec(layout, shapes)
+    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+    lr = config.learning_rate
+    sharded = layout is not None
+
+    if sharded:
+        chunk = layout.max_shard
+        pad_len = max(W * chunk, layout.total + chunk)
+        starts = np.asarray(layout.shard_starts, np.int32)
+        if len(starts) < W:
+            starts = np.concatenate(
+                [starts, np.full(W - len(starts), layout.total, np.int32)]
+            )
+        # Static map: flat position j -> (owner shard, intra-chunk offset),
+        # used to slice a flat vector into [W, chunk] owner rows and back.
+        slice_idx = np.minimum(
+            starts[:, None] + np.arange(chunk, dtype=np.int32)[None, :], pad_len - 1
+        )
+        reassembly = coll.reassembly_index(layout)
+
+    def grad_one(wp_flat, x, y, rng):
+        params = coll.unflatten_params(wp_flat, spec)
+        loss, grads = jax.value_and_grad(cnn.loss_fn)(
+            params,
+            x,
+            y,
+            dropout_rng=rng if config.keep_prob < 1.0 else None,
+            keep_prob=config.keep_prob,
+            compute_dtype=compute_dtype,
+        )
+        return loss, coll.flatten_params(grads, spec)
+
+    def my_batch(xs_r, ys_r):
+        """Per-device batch: sharded data arrives as [1, bs, ...] (this
+        worker's slice); the shard_data=False compat stream is replicated
+        [bs, ...] — every worker the same batch (mnist_async/worker.py:27-30)."""
+        if config.shard_data:
+            return xs_r[0], ys_r[0]
+        return xs_r, ys_r
+
+    def replicated_round(state: AsyncState, xs_r, ys_r, rng_r, sched_r):
+        idx = lax.axis_index(DP_AXIS)
+        wp = state.workers[idx]  # my stale replica [total]
+        rng = jax.random.fold_in(rng_r, idx)
+        x_b, y_b = my_batch(xs_r, ys_r)
+        loss, g = grad_one(wp, x_b, y_b, rng)
+        G = lax.all_gather(g, DP_AXIS, tiled=False)  # [W, total]
+        loss = lax.psum(loss, DP_AXIS) / W
+
+        def serve(carry, w):
+            ps, m, v, t, workers = carry
+            ps, m, v, t = _adam_push(ps, m, v, t, G[w], lr=lr)
+            workers = workers.at[w].set(ps)
+            return (ps, m, v, t, workers), None
+
+        (ps, m, v, t, workers), _ = lax.scan(
+            serve, (state.ps, state.m, state.v, state.t, state.workers), sched_r
+        )
+        return AsyncState(ps=ps, m=m, v=v, workers=workers, t=t), loss
+
+    def sharded_round(state: AsyncState, xs_r, ys_r, rng_r, sched_r):
+        idx = lax.axis_index(DP_AXIS)
+        wp = state.workers[0]  # my own row (sharded [1, total] per device)
+        rng = jax.random.fold_in(rng_r, idx)
+        x_b, y_b = my_batch(xs_r, ys_r)
+        loss, g = grad_one(wp, x_b, y_b, rng)
+        loss = lax.psum(loss, DP_AXIS) / W
+
+        # Scatter my grad's per-shard slices to their owners: one all_to_all.
+        g_slices = jnp.pad(g, (0, pad_len - layout.total))[
+            jnp.asarray(slice_idx)
+        ]  # [W(shards), chunk]
+        G = lax.all_to_all(
+            g_slices, DP_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )  # [W(workers), chunk] — every worker's grad for MY shard
+
+        def serve(carry, w):
+            ps, m, v, t = carry
+            ps, m, v, t = _adam_push(ps, m, v, t, G[w], lr=lr)
+            return (ps, m, v, t), ps  # ys: my chunk right after w's push
+
+        (ps, m, v, t), pushed = lax.scan(
+            serve, (state.ps, state.m, state.v, state.t), sched_r
+        )  # pushed: [W, chunk] in schedule order
+        # Reorder rows schedule-order -> worker-order, then return each
+        # worker its refreshed replica pieces: second all_to_all.
+        per_worker = jnp.zeros_like(pushed).at[sched_r].set(pushed)
+        pieces = lax.all_to_all(
+            per_worker, DP_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )  # [W(shards), chunk] — my replica's pieces from every shard
+        wp_new = pieces.reshape(-1)[jnp.asarray(reassembly)]
+        return (
+            AsyncState(ps=ps, m=m, v=v, workers=wp_new[None, :], t=t),
+            loss,
+        )
+
+    round_fn = sharded_round if sharded else replicated_round
+
+    def run(state: AsyncState, xs, ys, rngs, scheds):
+        def body(st, xr):
+            x_r, y_r, rng_r, sched_r = xr
+            st, loss = round_fn(st, x_r, y_r, rng_r, sched_r)
+            return st, loss
+
+        state, losses = lax.scan(body, state, (xs, ys, rngs, scheds))
+        if sharded:
+            gathered = lax.all_gather(state.ps, DP_AXIS, tiled=True)
+            ps_full = gathered[jnp.asarray(reassembly)]
+        else:
+            ps_full = state.ps
+        return state, ps_full, jnp.mean(losses)
+
+    if sharded:
+        state_spec = AsyncState(
+            ps=P(DP_AXIS), m=P(DP_AXIS), v=P(DP_AXIS), workers=P(DP_AXIS), t=P()
+        )
+    else:
+        state_spec = AsyncState(ps=P(), m=P(), v=P(), workers=P(), t=P())
+    # Sharded stream: [R, W, bs, ...] split over workers. Compat replicated
+    # stream: [R, bs, ...] identical everywhere.
+    data_spec = P(None, DP_AXIS) if config.shard_data else P()
+
+    smapped = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(state_spec, data_spec, data_spec, P(), P()),
+        out_specs=(state_spec, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=donation_for(mesh, 0))
+
+
+def async_state_init(
+    config: TrainConfig,
+    mesh: Mesh,
+    layout: LayoutAssignment | None,
+    params: dict,
+) -> AsyncState:
+    """Initial async state: PS params = worker replicas = ``params``."""
+    W = mesh.devices.size
+    spec = _flat_spec(layout, cnn.param_shapes(params))
+    flat = np.asarray(coll.flatten_params(jax.tree.map(jnp.asarray, params), spec))
+    t = jnp.zeros((), jnp.int32)
+    if layout is None:
+        rep = NamedSharding(mesh, P())
+        ps = jax.device_put(jnp.asarray(flat), rep)
+        workers = jax.device_put(jnp.tile(flat, (W, 1)), rep)
+        zeros = jax.device_put(jnp.zeros_like(ps), rep)
+        return AsyncState(
+            ps=ps, m=zeros, v=jnp.copy(zeros), workers=workers,
+            t=jax.device_put(t, rep),
+        )
+    chunk = layout.max_shard
+    pad_len = max(W * chunk, layout.total + chunk)
+    starts = np.asarray(layout.shard_starts, np.int32)
+    if len(starts) < W:
+        starts = np.concatenate(
+            [starts, np.full(W - len(starts), layout.total, np.int32)]
+        )
+    padded = np.pad(flat, (0, pad_len - flat.shape[0]))
+    slice_idx = np.minimum(
+        starts[:, None] + np.arange(chunk, dtype=np.int32)[None, :], pad_len - 1
+    )
+    ps_chunks = padded[slice_idx].reshape(-1)  # [W * chunk], owner-major
+    shard = NamedSharding(mesh, P(DP_AXIS))
+    ps = jax.device_put(jnp.asarray(ps_chunks), shard)
+    zeros = jax.device_put(jnp.zeros_like(ps), shard)
+    workers = jax.device_put(jnp.tile(flat, (W, 1)), shard)  # row w on device w
+    return AsyncState(
+        ps=ps, m=zeros, v=jnp.copy(zeros), workers=workers,
+        t=jax.device_put(t, NamedSharding(mesh, P())),
+    )
+
+
+class AsyncTrainer:
+    """Drives the async strategies (``mnist_async*`` parity) with the
+    deterministic seeded schedule. One epoch = ``num_train // (batch_size*W)``
+    rounds of W pushes each, so total PS updates match the reference's
+    one-epoch push count; ``shard_data=False`` reproduces the reference's
+    every-worker-sees-every-batch stream (mnist_async/worker.py:27-30)."""
+
+    def __init__(
+        self,
+        config: TrainConfig,
+        dataset: Dataset,
+        mesh: Mesh | None = None,
+        init: dict | None = None,
+    ):
+        self.config = config
+        self.dataset = dataset
+        self.mesh = mesh if mesh is not None else make_mesh(config.num_workers)
+        W = self.mesh.devices.size
+        if W != config.num_workers:
+            raise ValueError(
+                f"mesh has {W} devices, config.num_workers={config.num_workers}"
+            )
+        key = jax.random.PRNGKey(config.seed)
+        self.init_key, self.dropout_key = jax.random.split(key)
+        params = init if init is not None else cnn.init_params(self.init_key)
+        shapes = cnn.param_shapes(params)
+        sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
+        self.layout = resolve_layout(config, W, sizes)
+        self.state = async_state_init(config, self.mesh, self.layout, params)
+        self._run = make_async_round(config, self.mesh, self.layout, shapes)
+        self._spec = _flat_spec(self.layout, shapes)
+        self._unflatten = jax.jit(lambda f: coll.unflatten_params(f, self._spec))
+
+    def _batches(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """Arrange train data as ``[rounds, W, bs, ...]``."""
+        cfg = self.config
+        ds = self.dataset
+        W = cfg.num_workers
+        bs = cfg.batch_size
+        x = np.asarray(ds.x_train)
+        y = one_hot(ds.y_train)
+        if cfg.shard_data:
+            rounds = ds.num_train // (bs * W)
+            n = rounds * bs * W
+            # Worker w gets the w-th contiguous 1/W slice of the train set.
+            xs = x[:n].reshape(W, rounds, bs, -1).transpose(1, 0, 2, 3)
+            ys = y[:n].reshape(W, rounds, bs, -1).transpose(1, 0, 2, 3)
+        else:
+            # Reference stream: every worker trains on the same batches —
+            # stored once, replicated by the data sharding ([R, bs, ...]).
+            rounds = ds.num_train // bs
+            n = rounds * bs
+            xs = x[:n].reshape(rounds, bs, -1)
+            ys = y[:n].reshape(rounds, bs, -1)
+        if rounds < 1:
+            need = bs * W if cfg.shard_data else bs
+            raise ValueError(
+                f"dataset too small for async training: {ds.num_train} train "
+                f"examples < one round ({need} = batch_size"
+                f"{' * num_workers' if cfg.shard_data else ''})"
+            )
+        return np.ascontiguousarray(xs), np.ascontiguousarray(ys), rounds
+
+    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+        cfg = self.config
+        W = cfg.num_workers
+        xs_all, ys_all, rounds = self._batches()
+        x_test = jnp.asarray(self.dataset.x_test)
+        y_test = jnp.asarray(one_hot(self.dataset.y_test))
+        data_sharding = NamedSharding(
+            self.mesh, P(None, DP_AXIS) if cfg.shard_data else P()
+        )
+
+        state = self.state
+        history: list[tuple[int, int, float]] = []
+        chunk_rounds = cfg.eval_every if cfg.eval_every else rounds
+        images_per_round = cfg.batch_size * W  # W pushes of one batch each
+        images = 0
+        train_time = 0.0
+        start = time.perf_counter()
+        seg = start
+        ps_full = None
+        for epoch in range(cfg.epochs):
+            scheds = async_schedule(cfg.staleness_seed + epoch, W, rounds)
+            for lo in range(0, rounds, chunk_rounds):
+                hi = min(lo + chunk_rounds, rounds)
+                rngs = jnp.stack(
+                    [
+                        jax.random.fold_in(self.dropout_key, epoch * rounds + r)
+                        for r in range(lo, hi)
+                    ]
+                )
+                xb = jax.device_put(xs_all[lo:hi], data_sharding)
+                yb = jax.device_put(ys_all[lo:hi], data_sharding)
+                state, ps_full, _ = self._run(
+                    state, xb, yb, rngs, jnp.asarray(scheds[lo:hi])
+                )
+                images += images_per_round * (hi - lo)
+                if cfg.eval_every:
+                    jax.block_until_ready(ps_full)
+                    train_time += time.perf_counter() - seg
+                    params = self._unflatten(ps_full)
+                    acc = evaluate(params, x_test, y_test)
+                    history.append((epoch, lo, acc))
+                    log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
+                    seg = time.perf_counter()
+        jax.block_until_ready(ps_full)
+        end = time.perf_counter()
+        train_time += end - seg
+        params = self._unflatten(ps_full)
+        final_acc = evaluate(params, x_test, y_test)
+        log(f"final accuracy: {final_acc}")
+        self.state = state
+        return TrainResult(
+            params=jax.tree.map(np.asarray, params),
+            final_accuracy=final_acc,
+            wall_time_s=end - start,
+            train_time_s=train_time,
+            history=history,
+            images_per_sec=images / train_time if train_time > 0 else 0.0,
+        )

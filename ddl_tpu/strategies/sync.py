@@ -1,0 +1,329 @@
+"""Synchronous strategies: pure data-parallel and parameter-sharded (ZeRO-1).
+
+Reference semantics being re-designed (not translated):
+
+- ``mnist_sync``: every worker pushes its 14 grads to one PS, which sums them
+  (never averaging — parameter_server.py:36-37), takes one Adam step, and
+  broadcasts fresh params; workers barrier on the Bcast
+  (mnist_sync/worker.py:60-72, parameter_server.py:54-69).
+  TPU-native: one SPMD program per step — per-chip grads, ``psum`` over the
+  ICI mesh axis (default mean; ``grad_reduction="sum"`` reproduces the
+  reference's summed-LR behavior), replicated Adam. The PS process, the
+  py_function grad escape hatch, and the 14 per-var round-trips all vanish
+  into one compiled step.
+
+- ``mnist_sync_sharding[_greedy]``: M PS ranks each own a block of variables
+  and update only their shard (parameter_server.py:30-32,42-69); the greedy
+  variant permutes variables before blocking (greedy worker.py:14-37).
+  TPU-native: ZeRO-1 — flatten params into one vector in layout order,
+  reduce-scatter grads so each device owns a slice, shard-local Adam (m/v
+  live ONLY on the owner — the memory win), all-gather updated params.
+  Layout policies: "flat" (equal chunks, bandwidth-optimal psum_scatter),
+  "block"/"zigzag"/"lpt" (variable-aligned owner ranges, reproducing and
+  generalizing the reference's partitioning — see ddl_tpu.parallel.layout).
+
+Numerics: with ``grad_reduction="mean"`` and no dropout, every sync strategy
+is step-equivalent to the single-chip trainer on the same global batch (the
+parity tests assert this); sharded vs unsharded are equivalent for any
+layout because Adam is elementwise.
+
+The reference's sharded-PS aggregation bug (aliased buffers double-counting
+workers, parameter_server.py:43-47,77-80 — SURVEY.md §3.5) is *not*
+reproduced: psum/psum_scatter are correct by construction, and
+``tests/test_sync_strategies.py`` pins the correct aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data import Dataset, one_hot
+from ..models import cnn
+from ..ops import AdamState, adam_init, adam_update
+from ..parallel import collectives as coll
+from ..parallel.layout import LayoutAssignment, assign_layout
+from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
+from ..train.config import TrainConfig
+from ..train.trainer import TrainResult, evaluate
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ShardedAdam:
+    """Adam state over the flat param vector, sharded along the mesh axis.
+
+    ``m``/``v`` hold only this framework's analogue of a PS shard's slots
+    (reference: per-shard optimizer at
+    mnist_sync_sharding/parameter_server.py:56-69): globally ``[S * max_shard]``
+    with ``NamedSharding(P(DP_AXIS))``, i.e. ``max_shard`` elements resident
+    per device — the ZeRO-1 memory saving.
+    """
+
+    step: jax.Array  # int32 scalar, replicated
+    m: jax.Array
+    v: jax.Array
+
+
+def _adam_flat(p, state: ShardedAdam, g, *, lr, b1=0.9, b2=0.999, eps=1e-8):
+    """TF1-semantics Adam (see ddl_tpu.ops.optimizers) on flat slices."""
+    step = state.step + 1
+    t = step.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+    m = b1 * state.m + (1.0 - b1) * g
+    v = b2 * state.v + (1.0 - b2) * g * g
+    return p - lr_t * m / (jnp.sqrt(v) + eps), ShardedAdam(step=step, m=m, v=v)
+
+
+def _local_grads(config: TrainConfig, params, x, y, rng, axis: str):
+    """Per-device loss+grads with a device-distinct dropout stream
+    (reference workers use independent masks — SURVEY.md §7d)."""
+    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+    rng = jax.random.fold_in(rng, lax.axis_index(axis))
+    loss, grads = jax.value_and_grad(cnn.loss_fn)(
+        params,
+        x,
+        y,
+        dropout_rng=rng if config.keep_prob < 1.0 else None,
+        keep_prob=config.keep_prob,
+        compute_dtype=compute_dtype,
+    )
+    return loss, grads
+
+
+def make_dp_step(config: TrainConfig, mesh: Mesh) -> Callable:
+    """Pure sync DP (``mnist_sync`` parity): psum grads, replicated Adam.
+
+    Returns jitted ``step(params, opt_state, x, y, rng) -> (params, opt, loss)``
+    with ``x``/``y`` batch-sharded over the mesh axis (or replicated when
+    ``config.shard_data=False``, reproducing the reference's identical-batches
+    behavior, mnist_sync/worker.py:27-30).
+    """
+    W = mesh.devices.size
+    data_spec = P(DP_AXIS) if config.shard_data else P()
+    mean = config.grad_reduction == "mean"
+
+    def step(params, opt_state, x, y, rng):
+        loss, grads = _local_grads(config, params, x, y, rng, DP_AXIS)
+        grads = lax.psum(grads, DP_AXIS)
+        loss = lax.psum(loss, DP_AXIS) / W
+        if mean:
+            grads = jax.tree.map(lambda g: g / W, grads)
+        params, opt_state = adam_update(
+            params, opt_state, grads, lr=config.learning_rate
+        )
+        return params, opt_state, loss
+
+    smapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(), data_spec, data_spec, P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=donation_for(mesh, 0, 1))
+
+
+def make_sharded_step(
+    config: TrainConfig,
+    mesh: Mesh,
+    layout: LayoutAssignment,
+    shapes: Mapping[str, tuple[int, ...]] | None = None,
+) -> Callable:
+    """ZeRO-1 sharded sync step (``mnist_sync_sharding[_greedy]`` parity).
+
+    Returns jitted ``step(params, sharded_opt, x, y, rng)``. Collective
+    schedule per step (all along the ICI mesh axis):
+
+      flat grads --reduce_scatter--> owner slice --local Adam-->
+      updated slice --all_gather--> full flat params
+
+    For the "flat" layout the reduce-scatter is a single fused
+    ``psum_scatter`` (bandwidth-optimal); variable-aligned layouts reduce
+    with ``psum`` then slice the unequal owner range (padded to max_shard).
+    """
+    W = mesh.devices.size
+    spec = coll.FlatSpec.from_layout(layout, shapes or dict(cnn.PARAM_SPECS))
+    data_spec = P(DP_AXIS) if config.shard_data else P()
+    mean = config.grad_reduction == "mean"
+    # The fused psum_scatter path needs one equal chunk per mesh device.
+    equal_chunks = layout.policy == "flat" and layout.num_shards == W
+    chunk = layout.max_shard
+    reassembly = coll.reassembly_index(layout)
+    starts = np.asarray(layout.shard_starts, np.int32)
+    if len(starts) < W:
+        # Fewer shards than devices (num_ps < num_workers): surplus devices
+        # own an empty range parked at the padding tail.
+        starts = np.concatenate([starts, np.full(W - len(starts), layout.total, np.int32)])
+    # Enough padding that every device's (start, chunk) slice is in bounds.
+    pad_len = max(W * chunk, layout.total + chunk)
+
+    def step(params, opt: ShardedAdam, x, y, rng):
+        loss, grads = _local_grads(config, params, x, y, rng, DP_AXIS)
+        loss = lax.psum(loss, DP_AXIS) / W
+        g_flat = coll.flatten_params(grads, spec)
+        p_flat = coll.flatten_params(params, spec)
+
+        if equal_chunks:
+            g_own = coll.reduce_scatter_flat(g_flat, W, DP_AXIS, mean=mean)
+            my_start = lax.axis_index(DP_AXIS) * chunk
+        else:
+            g_red = lax.psum(g_flat, DP_AXIS)
+            if mean:
+                g_red = g_red / W
+            my_start = jnp.asarray(starts)[lax.axis_index(DP_AXIS)]
+            g_own = lax.dynamic_slice(
+                jnp.pad(g_red, (0, pad_len - layout.total)), (my_start,), (chunk,)
+            )
+
+        p_own = lax.dynamic_slice(
+            jnp.pad(p_flat, (0, pad_len - layout.total)), (my_start,), (chunk,)
+        )
+        p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
+
+        gathered = lax.all_gather(p_new, DP_AXIS, tiled=True)  # [W * chunk]
+        if equal_chunks:
+            full = gathered[: layout.total]
+        else:
+            full = gathered[jnp.asarray(reassembly)]
+        return coll.unflatten_params(full, spec), opt, loss
+
+    smapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), data_spec, data_spec, P()),
+        out_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=donation_for(mesh, 0, 1))
+
+
+def sharded_adam_init(mesh: Mesh, layout: LayoutAssignment) -> ShardedAdam:
+    """Zero-initialized sharded Adam state, placed ``P(DP_AXIS)``."""
+    W = mesh.devices.size
+    sharding = NamedSharding(mesh, P(DP_AXIS))
+    z = jnp.zeros((W * layout.max_shard,), jnp.float32)
+    z = jax.device_put(z, sharding)
+    return ShardedAdam(
+        step=jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P())),
+        m=z,
+        v=jnp.copy(z),
+    )
+
+
+def resolve_layout(
+    config: TrainConfig,
+    num_devices: int,
+    sizes: dict[str, int] | None = None,
+) -> LayoutAssignment | None:
+    """Map config topology to a layout. ``num_ps <= 1`` and layout unset
+    means pure DP (no sharding); otherwise resolve the policy over the
+    model's variable table (``sizes``; defaults to the flagship CNN). On TPU
+    the shards co-locate with the workers (ZeRO) — there are no separate PS
+    processes, so ``num_ps`` means "number of devices that own a param
+    shard" and must be <= the mesh size."""
+    if config.num_ps <= 1:
+        return None
+    if config.num_ps > num_devices:
+        raise ValueError(
+            f"num_ps={config.num_ps} exceeds mesh size {num_devices}: TPU "
+            "shards co-locate with workers (ZeRO); use num_ps <= num_workers"
+        )
+    if sizes is None:
+        sizes = cnn.param_sizes()
+    # num_ps is honored for every policy; "flat" additionally unlocks the
+    # fused psum_scatter fast path when num_ps == num_workers (full ZeRO-1).
+    return assign_layout(config.layout, config.num_ps, list(sizes), sizes)
+
+
+class SyncTrainer:
+    """Drives any sync strategy over an epoch loop with the reference's
+    eval-every-10-batches cadence (mnist_sync/worker.py:71-72)."""
+
+    def __init__(
+        self,
+        config: TrainConfig,
+        dataset: Dataset,
+        mesh: Mesh | None = None,
+        init: dict | None = None,
+    ):
+        self.config = config
+        self.dataset = dataset
+        self.mesh = mesh if mesh is not None else make_mesh(config.num_workers)
+        W = self.mesh.devices.size
+        if W != config.num_workers:
+            raise ValueError(f"mesh has {W} devices, config.num_workers={config.num_workers}")
+        key = jax.random.PRNGKey(config.seed)
+        self.init_key, self.dropout_key = jax.random.split(key)
+        params = init if init is not None else cnn.init_params(self.init_key)
+        shapes = cnn.param_shapes(params)
+        sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
+        self.layout = resolve_layout(config, W, sizes)
+        self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
+        if self.layout is None:
+            self.opt_state: Any = jax.device_put(
+                adam_init(params), NamedSharding(self.mesh, P())
+            )
+            self._step = make_dp_step(config, self.mesh)
+        else:
+            self.opt_state = sharded_adam_init(self.mesh, self.layout)
+            self._step = make_sharded_step(config, self.mesh, self.layout, shapes)
+
+    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+        cfg = self.config
+        ds = self.dataset
+        x_train = np.asarray(ds.x_train)
+        y_train = one_hot(ds.y_train)
+        x_test = jnp.asarray(ds.x_test)
+        y_test = jnp.asarray(one_hot(ds.y_test))
+        data_sharding = NamedSharding(
+            self.mesh, P(DP_AXIS) if cfg.shard_data else P()
+        )
+
+        params, opt_state = self.params, self.opt_state
+        # Global batch per step; when data is sharded each device sees
+        # batch_size/W examples (per_worker_batch validates divisibility).
+        if cfg.shard_data:
+            cfg.per_worker_batch()
+        batch_num = ds.num_train // cfg.batch_size
+        history: list[tuple[int, int, float]] = []
+        images = 0
+        train_time = 0.0
+        start = time.perf_counter()
+        seg = start
+        for epoch in range(cfg.epochs):
+            for cnt in range(batch_num):
+                lo, hi = cfg.batch_size * cnt, cfg.batch_size * (cnt + 1)
+                xb = jax.device_put(x_train[lo:hi], data_sharding)
+                yb = jax.device_put(y_train[lo:hi], data_sharding)
+                rng = jax.random.fold_in(self.dropout_key, epoch * batch_num + cnt)
+                params, opt_state, _ = self._step(params, opt_state, xb, yb, rng)
+                images += cfg.batch_size
+                if cfg.eval_every and cnt % cfg.eval_every == 0:
+                    jax.block_until_ready(params)
+                    train_time += time.perf_counter() - seg
+                    acc = evaluate(params, x_test, y_test)
+                    history.append((epoch, cnt, acc))
+                    log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                    seg = time.perf_counter()
+        jax.block_until_ready(params)
+        end = time.perf_counter()
+        train_time += end - seg
+        final_acc = evaluate(params, x_test, y_test)
+        log(f"final accuracy: {final_acc}")
+        self.params, self.opt_state = params, opt_state
+        return TrainResult(
+            params=jax.tree.map(np.asarray, params),
+            final_accuracy=final_acc,
+            wall_time_s=end - start,
+            train_time_s=train_time,
+            history=history,
+            images_per_sec=images / train_time if train_time > 0 else 0.0,
+        )

@@ -47,11 +47,11 @@ class TrainConfig:
     grad_reduction: Literal["mean", "sum"] = "mean"
     shard_data: bool = True
 
-    # Async-only: deterministic staleness schedule seed (SURVEY.md section 4d).
+    # Async-only: deterministic staleness schedule seed (SURVEY.md section
+    # 4d). The staleness envelope itself is structural: a worker's params go
+    # stale by up to 2*num_workers-1 pushes between its own pulls (see
+    # ddl_tpu.strategies.async_ps).
     staleness_seed: int = 0
-    # Async-only: max param-staleness (in updates) tolerated before a worker
-    # refreshes; models the Hogwild envelope explicitly instead of racing.
-    max_staleness: int = 4
 
     # TPU numerics: compute dtype for the forward/backward pass.
     # None = fp32 (reference parity); "bfloat16" engages the MXU fast path.

@@ -80,14 +80,14 @@ def evaluate(
 class SingleChipTrainer:
     """`single.py`-equivalent training on one device."""
 
-    def __init__(self, config: TrainConfig, dataset: Dataset):
+    def __init__(self, config: TrainConfig, dataset: Dataset, init: dict | None = None):
         self.config = config
         self.dataset = dataset
         self.y_train_onehot = one_hot(dataset.y_train)
         self.y_test_onehot = one_hot(dataset.y_test)
         key = jax.random.PRNGKey(config.seed)
         self.init_key, self.dropout_key = jax.random.split(key)
-        self.params = cnn.init_params(self.init_key)
+        self.params = init if init is not None else cnn.init_params(self.init_key)
         self.opt_state = adam_init(self.params)
         self._step = jax.jit(make_train_step(config))
 

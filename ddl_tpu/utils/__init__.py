@@ -1,0 +1,5 @@
+"""Auxiliary subsystems the reference lacks entirely (SURVEY.md §5 gap-fill):
+checkpoint/resume, metrics/timing, profiling hooks."""
+
+from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
+from .metrics import StepTimer, trace  # noqa: F401

@@ -1,0 +1,95 @@
+"""Checkpoint / resume.
+
+The reference has **no** persistence: params live only in TF session memory
+and training is restart-from-scratch (SURVEY.md §5 "checkpoint/resume:
+none"; reference model graph + session at mnist_sync/model/model.py:109-112).
+This module fills that gap with a dependency-light ``.npz`` checkpoint of any
+params/optimizer pytree, usable from every strategy (sharded state is
+gathered to host before saving, re-placed by the caller's sharding after
+loading).
+
+Atomicity: writes go to a temp file then ``os.replace`` — a crash mid-save
+never corrupts the previous checkpoint (the failure-recovery story the
+reference lacks, SURVEY.md §5 "failure detection: none").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+_META_KEY = "__meta__"
+
+
+def _flatten_with_paths(tree: Any) -> dict[str, np.ndarray]:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def save_checkpoint(
+    path: str | os.PathLike,
+    tree: Any,
+    *,
+    step: int | None = None,
+    extra: dict | None = None,
+) -> None:
+    """Atomically save a pytree (params, optimizer state, ...) to ``path``.
+
+    Device/sharded arrays are fetched to host. ``extra`` must be
+    JSON-serializable metadata (config echo, accuracy, ...).
+    """
+    arrays = _flatten_with_paths(tree)
+    meta = {"step": step, "extra": extra or {}}
+    d = os.path.dirname(os.fspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    # Suffix must be .npz or np.savez appends one, orphaning the temp path.
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)  # np.savez owns the file (and its ZipFile finalization)
+    try:
+        np.savez(tmp, **{_META_KEY: np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )}, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(
+    path: str | os.PathLike, like: Any
+) -> tuple[Any, int | None, dict]:
+    """Load a checkpoint into the structure of ``like``.
+
+    Returns ``(tree, step, extra)``. The caller re-places arrays onto
+    devices/shardings (e.g. ``jax.device_put(tree, sharding)``).
+    """
+    with np.load(path) as data:
+        meta = json.loads(bytes(data[_META_KEY]).decode())
+        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for p, leaf in flat:
+            key = jax.tree_util.keystr(p)
+            if key not in data:
+                raise KeyError(f"checkpoint {path} missing leaf {key}")
+            saved = data[key]
+            want = np.shape(leaf)
+            if tuple(saved.shape) != tuple(want):
+                raise ValueError(
+                    f"checkpoint leaf {key} has shape {saved.shape}, "
+                    f"expected {want}"
+                )
+            leaves.append(saved)
+        tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(like), leaves
+        )
+    return tree, meta.get("step"), meta.get("extra", {})

@@ -1,0 +1,92 @@
+"""Metrics / timing / profiling.
+
+The reference's only observability is ``print`` of rank/epoch/accuracy and a
+``time.clock()`` wall bracket (SURVEY.md §5 "tracing/profiling: none";
+reference timing at mnist_sync/worker.py:45,74-76 — NB ``time.clock`` was
+removed in Python 3.8). This module is the first-class replacement: a
+steady-state step timer with percentile stats, and a ``jax.profiler`` trace
+context for TPU timeline capture (view in TensorBoard / Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class StepStats:
+    steps: int
+    mean_ms: float
+    p50_ms: float
+    p95_ms: float
+    total_s: float
+    images_per_sec: float
+
+    def line(self) -> str:
+        return (
+            f"steps={self.steps} mean={self.mean_ms:.2f}ms "
+            f"p50={self.p50_ms:.2f}ms p95={self.p95_ms:.2f}ms "
+            f"throughput={self.images_per_sec:.0f} img/s"
+        )
+
+
+class StepTimer:
+    """Per-step wall-clock timer with warmup exclusion.
+
+    Usage::
+
+        timer = StepTimer(batch_size=100, warmup=2)
+        for ...:
+            with timer.step():
+                params, opt, _ = train_step(...)
+        print(timer.stats().line())
+
+    Timing includes dispatch but the caller should block on the result
+    inside the ``step()`` context for accurate numbers (or rely on jit's
+    implicit data dependence on the previous step's output, the steady-state
+    pattern used by ``bench.py``).
+    """
+
+    def __init__(self, batch_size: int, warmup: int = 2):
+        self.batch_size = batch_size
+        self.warmup = warmup
+        self._times: list[float] = []
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        self._times.append(time.perf_counter() - t0)
+
+    def stats(self) -> StepStats:
+        times = np.asarray(self._times[self.warmup :])
+        if times.size == 0:
+            return StepStats(0, 0.0, 0.0, 0.0, 0.0, 0.0)
+        total = float(times.sum())
+        return StepStats(
+            steps=int(times.size),
+            mean_ms=float(times.mean() * 1e3),
+            p50_ms=float(np.percentile(times, 50) * 1e3),
+            p95_ms=float(np.percentile(times, 95) * 1e3),
+            total_s=total,
+            images_per_sec=times.size * self.batch_size / total,
+        )
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """``jax.profiler`` trace scope; no-op when ``log_dir`` is None."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

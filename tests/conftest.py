@@ -1,10 +1,13 @@
-"""Test environment: force an 8-device virtual CPU mesh before JAX loads,
-so every multi-chip strategy is exercised hermetically (SURVEY.md section 4b)."""
+"""Test environment: force an 8-device virtual CPU mesh so every multi-chip
+strategy is exercised hermetically (SURVEY.md section 4b).
+
+The TPU tunnel's sitecustomize registers its PJRT plugin and forces
+``jax_platforms`` programmatically, so env vars alone are not enough — we
+must override the config after importing jax and before any backend is
+initialized."""
 
 import os
 
-# Force CPU even when the environment pins a TPU platform (JAX_PLATFORMS=axon):
-# tests must be hermetic and exercise the 8-device virtual mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -12,16 +15,36 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"tests need the 8-device virtual CPU mesh, got {jax.devices()}"
+)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from ddl_tpu.data import load_mnist  # noqa: E402
+from ddl_tpu.models import cnn  # noqa: E402
+
+# Narrow-width instance of the reference architecture family: identical
+# structure (14 vars, 4 conv+pool stages, 2 dropout FCs) at ~1/400 the
+# FLOPs, so multi-device integration tests fit a single-core CPU host.
+# Full-width parity with the torch oracle is covered in test_model.py.
+SMALL_SPECS = cnn.make_param_specs(conv_channels=(4, 8, 8, 8), fc_sizes=(32, 16))
 
 
 @pytest.fixture(scope="session")
 def small_dataset():
     """A small deterministic procedural dataset shared across tests."""
     return load_mnist(path=None, synthetic_train=2048, synthetic_test=512, seed=7)
+
+
+@pytest.fixture(scope="session")
+def small_params():
+    """Params for the narrow test model (see SMALL_SPECS)."""
+    return cnn.init_params(jax.random.PRNGKey(3), specs=SMALL_SPECS)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,53 @@
+"""CLI argument → config mapping (the run.sh replacement, SURVEY.md §1
+launcher layer). Pure parsing — no training, no device use."""
+
+from ddl_tpu.cli import build_parser, config_from_args
+
+
+def _cfg(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_sharding_variant_maps_num_ps():
+    # reference: run.sh $1=num_ps $2=num_workers (mnist_sync_sharding/run.sh)
+    cfg = _cfg(["sync_sharding", "--num-ps", "4", "--num-workers", "8"])
+    assert cfg.num_ps == 4
+    assert cfg.num_workers == 8
+    assert cfg.layout == "block"
+
+
+def test_greedy_variant_defaults_zigzag():
+    cfg = _cfg(["async_sharding_greedy", "--num-ps", "2", "--num-workers", "4"])
+    assert cfg.layout == "zigzag"
+    assert cfg.num_ps == 2
+
+
+def test_unsharded_variant_forces_single_ps():
+    cfg = _cfg(["sync", "--num-ps", "5", "--num-workers", "4"])
+    assert cfg.num_ps == 1  # unsharded variants ignore --num-ps
+
+
+def test_reference_compat_flags():
+    cfg = _cfg(["sync", "--num-workers", "2", "--reference-compat"])
+    assert cfg.grad_reduction == "sum"
+    assert cfg.shard_data is False
+    default = _cfg(["sync", "--num-workers", "2"])
+    assert default.grad_reduction == "mean"
+    assert default.shard_data is True
+
+
+def test_reference_hyperparameter_defaults():
+    # epoch=1, batch=100, lr=1e-4, keep_prob=0.5, eval every 10
+    # (reference worker.py:41-42, model.py:93, worker.py:30,71).
+    cfg = _cfg(["single"])
+    assert cfg.epochs == 1
+    assert cfg.batch_size == 100
+    assert cfg.learning_rate == 1e-4
+    assert cfg.keep_prob == 0.5
+    assert cfg.eval_every == 10
+    assert cfg.num_workers == 1
+
+
+def test_bf16_flag():
+    assert _cfg(["single", "--bf16"]).compute_dtype == "bfloat16"
+    assert _cfg(["single"]).compute_dtype is None

@@ -1,0 +1,116 @@
+"""Layout-policy unit tests (SURVEY.md §4a: sharding index math + greedy
+ordering vs the reference's algorithms)."""
+
+import numpy as np
+import pytest
+
+from ddl_tpu.models import cnn
+from ddl_tpu.parallel.layout import (
+    assign_layout,
+    lpt_order,
+    zigzag_order,
+)
+
+NAMES = list(cnn.PARAM_NAMES)
+SIZES = cnn.param_sizes()
+
+
+def test_zigzag_matches_reference_order():
+    # The exact greedy order the reference produces for the 14-var CNN
+    # (mnist_sync_sharding_greedy/worker.py:14-30; SURVEY.md §2.2).
+    expected = "v13 v8 v1 v6 v3 v10 v5 v4 v7 v2 v11 v12 v0 v9".split()
+    assert zigzag_order(NAMES, SIZES) == expected
+
+
+def test_block_partition_reference_semantics():
+    # L = num_vars // num_ps per shard, last shard absorbs the remainder
+    # (mnist_sync_sharding/parameter_server.py:30-32).
+    a = assign_layout("block", 4, NAMES, SIZES)
+    counts = [sum(1 for n in NAMES if a.var_to_shard[n] == s) for s in range(4)]
+    assert counts == [3, 3, 3, 5]
+    # Routing parity: var i belongs to shard min(i // L, S-1)
+    # (mnist_sync_sharding/worker.py:33-36).
+    for i, n in enumerate(NAMES):
+        assert a.var_to_shard[n] == min(i // 3, 3)
+
+
+@pytest.mark.parametrize("policy", ["block", "zigzag", "lpt", "flat"])
+@pytest.mark.parametrize("num_shards", [1, 2, 4, 7, 8])
+def test_assignment_partitions_all_elements(policy, num_shards):
+    if policy != "flat" and num_shards > len(NAMES):
+        pytest.skip("var-granular needs shards <= vars")
+    a = assign_layout(policy, num_shards, NAMES, SIZES)
+    assert sum(a.shard_sizes) == a.total == sum(SIZES.values())
+    # Shard ranges are contiguous and disjoint in flat space.
+    off = 0
+    for st, sz in zip(a.shard_starts, a.shard_sizes):
+        assert st == off
+        off += sz
+    # Every var appears exactly once in the order.
+    assert sorted(a.order) == sorted(NAMES)
+    # var_offsets consistent with order.
+    off = 0
+    for n in a.order:
+        assert a.var_offsets[n] == off
+        off += SIZES[n]
+
+
+def test_var_aligned_boundaries():
+    for policy in ("block", "zigzag", "lpt"):
+        a = assign_layout(policy, 4, NAMES, SIZES)
+        # Each shard's element range is exactly the sum of its vars.
+        for s in range(4):
+            mine = [n for n in a.order if a.var_to_shard[n] == s]
+            assert a.shard_sizes[s] == sum(SIZES[n] for n in mine)
+
+
+def test_zigzag_balances_seven_shards():
+    # At 7 shards zigzag pairs each big tensor with a tiny one: every shard
+    # holds exactly one of the 7 largest tensors (SURVEY.md §2.2).
+    a = assign_layout("zigzag", 7, NAMES, SIZES)
+    big7 = sorted(SIZES.values())[-7:]
+    per_shard_max = []
+    for s in range(7):
+        mine = [SIZES[n] for n in a.order if a.var_to_shard[n] == s]
+        per_shard_max.append(max(mine))
+    assert sorted(per_shard_max) == sorted(big7)
+
+
+def test_lpt_beats_zigzag_at_two_shards():
+    # SURVEY.md §2.2: zigzag is actively worse than naive at 2 shards
+    # (2.39M vs 264k); LPT must do better.
+    z = assign_layout("zigzag", 2, NAMES, SIZES)
+    l = assign_layout("lpt", 2, NAMES, SIZES)
+    assert l.balance < z.balance
+    assert max(z.shard_sizes) > 2_000_000  # the pathological split
+    assert max(l.shard_sizes) < 1_500_000
+
+
+def test_lpt_order_groups_by_shard():
+    order, counts = lpt_order(NAMES, SIZES, 3)
+    assert sum(counts) == len(NAMES)
+    assert sorted(order) == sorted(NAMES)
+
+
+def test_flat_equal_chunks():
+    a = assign_layout("flat", 8, NAMES, SIZES)
+    chunk = -(-a.total // 8)
+    assert a.max_shard == chunk
+    assert a.balance == pytest.approx(chunk / (a.total / 8))
+    assert a.var_to_shard is None
+
+
+def test_reassembly_index_roundtrip():
+    from ddl_tpu.parallel.collectives import reassembly_index
+
+    for policy, shards in (("block", 4), ("zigzag", 7), ("lpt", 8), ("flat", 8)):
+        a = assign_layout(policy, shards, NAMES, SIZES)
+        rng = np.random.default_rng(0)
+        flat = rng.standard_normal(a.total).astype(np.float32)
+        m = a.max_shard
+        # Simulate per-shard padded slices, then reassemble.
+        padded = np.zeros((len(a.shard_starts), m), np.float32)
+        for s, (st, sz) in enumerate(zip(a.shard_starts, a.shard_sizes)):
+            padded[s, :sz] = flat[st : st + sz]
+        idx = reassembly_index(a)
+        np.testing.assert_array_equal(padded.reshape(-1)[idx], flat)

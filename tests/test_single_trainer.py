@@ -1,5 +1,8 @@
 """Single-chip trainer smoke + convergence tests (replaces the reference's
-eyeball accuracy oracle, single.py:17-21; SURVEY.md section 4c)."""
+eyeball accuracy oracle, single.py:17-21; SURVEY.md section 4c).
+
+Runs the narrow test model (conftest.SMALL_SPECS); trainer code is
+model-agnostic and full-width numerics are pinned in test_model.py."""
 
 import jax
 import numpy as np
@@ -7,29 +10,31 @@ import numpy as np
 from ddl_tpu.train import SingleChipTrainer, TrainConfig
 
 
-def test_trains_and_converges(small_dataset):
+def test_trains_and_converges(small_dataset, small_params):
     cfg = TrainConfig(
-        epochs=4, batch_size=64, learning_rate=1e-3, eval_every=0, seed=0
+        epochs=8, batch_size=64, learning_rate=3e-3, eval_every=0, seed=0
     )
-    trainer = SingleChipTrainer(cfg, small_dataset)
+    trainer = SingleChipTrainer(cfg, small_dataset, init=small_params)
     result = trainer.train(log=lambda s: None)
-    # 128 steps of Adam(1e-3) on the separable procedural set must beat chance
-    # decisively; full runs reach >99% (bench), this is the cheap CI bound.
-    assert result.final_accuracy > 0.8
+    # 256 steps of Adam(3e-3) on the separable procedural set reach ~0.9
+    # on the narrow model; full-width runs reach >99% (bench).
+    assert result.final_accuracy > 0.7
     assert result.wall_time_s > 0
     assert len(result.history) == 0  # eval_every=0 disables periodic eval
 
 
-def test_deterministic_given_seed(small_dataset):
+def test_deterministic_given_seed(small_dataset, small_params):
     cfg = TrainConfig(epochs=1, batch_size=256, eval_every=0, seed=3)
-    r1 = SingleChipTrainer(cfg, small_dataset).train(log=lambda s: None)
-    r2 = SingleChipTrainer(cfg, small_dataset).train(log=lambda s: None)
+    r1 = SingleChipTrainer(cfg, small_dataset, init=small_params).train(log=lambda s: None)
+    r2 = SingleChipTrainer(cfg, small_dataset, init=small_params).train(log=lambda s: None)
     for k in r1.params:
         np.testing.assert_array_equal(r1.params[k], r2.params[k])
 
 
-def test_eval_history(small_dataset):
+def test_eval_history(small_dataset, small_params):
     cfg = TrainConfig(epochs=1, batch_size=256, eval_every=4, seed=0)
-    result = SingleChipTrainer(cfg, small_dataset).train(log=lambda s: None)
+    result = SingleChipTrainer(cfg, small_dataset, init=small_params).train(
+        log=lambda s: None
+    )
     batches = [b for _, b, _ in result.history]
     assert batches == [0, 4]  # 2048/256 = 8 batches -> evals at 0 and 4

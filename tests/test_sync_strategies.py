@@ -1,0 +1,162 @@
+"""Sync-strategy integration tests on the 8-device virtual CPU mesh
+(SURVEY.md §4b): sync-DP ≡ single-process up to float tolerance, sharded ≡
+unsharded for every layout, and correct (non-reference-bug) aggregation.
+
+All tests run the narrow-width instance of the architecture family
+(conftest.SMALL_SPECS) — strategy code is model-agnostic, so the collective
+and sharding paths exercised are identical to the full model at ~1/400 the
+single-core cost; full-width numerics are pinned in test_model.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl_tpu.data import one_hot
+from ddl_tpu.models import cnn
+from ddl_tpu.ops import adam_init, adam_update
+from ddl_tpu.parallel.mesh import make_mesh
+from ddl_tpu.strategies.sync import (
+    make_dp_step,
+    make_sharded_step,
+    resolve_layout,
+    sharded_adam_init,
+)
+from ddl_tpu.train.config import TrainConfig
+
+GB = 32  # global batch
+
+
+@pytest.fixture(scope="module")
+def batch(small_dataset):
+    x = jnp.asarray(small_dataset.x_train[:GB])
+    y = jnp.asarray(one_hot(small_dataset.y_train[:GB]))
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def init(small_params):
+    return small_params, adam_init(small_params)
+
+
+def _sizes(params):
+    return {k: int(np.prod(v.shape)) if v.shape else 1 for k, v in params.items()}
+
+
+def _single_steps(params, opt, x, y, n, lr=1e-4):
+    """Oracle: sequential full-batch steps on one device (no dropout)."""
+    @jax.jit
+    def step(params, opt, x, y):
+        grads = jax.grad(cnn.loss_fn)(params, x, y, dropout_rng=None)
+        return adam_update(params, opt, grads, lr=lr)
+
+    for _ in range(n):
+        params, opt = step(params, opt, x, y)
+    return params
+
+
+def _max_abs_diff(a, b):
+    return max(
+        jax.tree.leaves(jax.tree.map(lambda u, v: float(jnp.max(jnp.abs(u - v))), a, b))
+    )
+
+
+def test_dp_matches_single_chip(batch, init):
+    """psum-mean DP over 8 devices ≡ one-device training on the same global
+    batch (keep_prob=1 ⇒ no dropout divergence)."""
+    x, y = batch
+    params, opt = init
+    W = 8
+    cfg = TrainConfig(num_workers=W, keep_prob=1.0, batch_size=GB)
+    mesh = make_mesh(W)
+    step = make_dp_step(cfg, mesh)
+    rep = NamedSharding(mesh, P())
+    p, o = jax.device_put(params, rep), jax.device_put(opt, rep)
+    rng = jax.random.PRNGKey(9)
+    for i in range(3):
+        p, o, loss = step(p, o, x, y, jax.random.fold_in(rng, i))
+    oracle = _single_steps(params, opt, x, y, 3)
+    assert _max_abs_diff(p, oracle) < 2e-5
+
+
+def test_dp_sum_compat_scales_update(batch, init):
+    """grad_reduction='sum' reproduces the reference's summed aggregation
+    (mnist_sync/parameter_server.py:36-37): equivalent to a single-chip step
+    whose gradient is W times larger."""
+    x, y = batch
+    params, opt = init
+    W = 8
+    mesh = make_mesh(W)
+    cfg = TrainConfig(
+        num_workers=W, keep_prob=1.0, batch_size=GB, grad_reduction="sum"
+    )
+    step = make_dp_step(cfg, mesh)
+    p, o, _ = step(params, opt, x, y, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def oracle_step(params, opt):
+        grads = jax.grad(cnn.loss_fn)(params, x, y, dropout_rng=None)
+        grads = jax.tree.map(lambda g: g * W, grads)
+        return adam_update(params, opt, grads, lr=cfg.learning_rate)
+
+    op, _ = oracle_step(params, opt)
+    assert _max_abs_diff(p, op) < 2e-5
+
+
+@pytest.mark.parametrize(
+    "policy,num_ps", [("flat", 8), ("block", 4), ("zigzag", 7), ("lpt", 8)]
+)
+def test_sharded_matches_dp(batch, init, policy, num_ps):
+    """ZeRO-1 sharded update ≡ replicated update for every layout policy —
+    Adam is elementwise, so ownership layout must not change numerics."""
+    x, y = batch
+    params, opt = init
+    W = 8
+    mesh = make_mesh(W)
+    cfg = TrainConfig(
+        num_workers=W, num_ps=num_ps, layout=policy, keep_prob=1.0, batch_size=GB
+    )
+    layout = resolve_layout(cfg, W, _sizes(params))
+    assert layout is not None
+    step = make_sharded_step(cfg, mesh, layout, cnn.param_shapes(params))
+    sopt = sharded_adam_init(mesh, layout)
+    p = params
+    rng = jax.random.PRNGKey(9)
+    for i in range(2):
+        p, sopt, loss = step(p, sopt, x, y, jax.random.fold_in(rng, i))
+    oracle = _single_steps(params, opt, x, y, 2)
+    assert _max_abs_diff(p, oracle) < 2e-5
+
+
+def test_sharded_state_is_sharded(init):
+    """The ZeRO-1 memory property: each device holds 1/S of Adam m/v."""
+    params, _ = init
+    W = 8
+    mesh = make_mesh(W)
+    cfg = TrainConfig(num_workers=W, num_ps=W, layout="flat", keep_prob=1.0)
+    layout = resolve_layout(cfg, W, _sizes(params))
+    sopt = sharded_adam_init(mesh, layout)
+    shards = sopt.m.addressable_shards
+    assert len(shards) == W
+    assert shards[0].data.shape[0] * W == sopt.m.shape[0]
+
+
+def test_multiworker_aggregation_is_mean_not_doubled(batch, init):
+    """Regression vs the reference's aliased-buffer double-count bug
+    (mnist_sync_sharding/parameter_server.py:43-47,77-80 — SURVEY.md §3.5):
+    with identical data on all workers and mean reduction, the aggregated
+    gradient equals the single-worker gradient exactly."""
+    x, y = batch
+    params, opt = init
+    W = 8
+    mesh = make_mesh(W)
+    # shard_data=False: every worker sees the identical full batch.
+    cfg = TrainConfig(
+        num_workers=W, keep_prob=1.0, batch_size=GB, shard_data=False
+    )
+    step = make_dp_step(cfg, mesh)
+    p, o, _ = step(params, opt, x, y, jax.random.PRNGKey(0))
+    oracle = _single_steps(params, opt, x, y, 1)
+    assert _max_abs_diff(p, oracle) < 1e-6

@@ -1,0 +1,58 @@
+"""Checkpoint + metrics unit tests (gap-fill subsystems, SURVEY.md §5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ddl_tpu.models import cnn
+from ddl_tpu.ops import adam_init
+from ddl_tpu.utils import StepTimer, load_checkpoint, save_checkpoint
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    opt = adam_init(params)
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(path, {"params": params, "opt": opt}, step=7,
+                    extra={"accuracy": 0.99})
+    like = {"params": params, "opt": adam_init(params)}
+    tree, step, extra = load_checkpoint(path, like)
+    assert step == 7
+    assert extra["accuracy"] == 0.99
+    for n in cnn.PARAM_NAMES:
+        np.testing.assert_array_equal(tree["params"][n], np.asarray(params[n]))
+    assert int(tree["opt"].step) == 0
+
+
+def test_checkpoint_shape_mismatch(tmp_path):
+    params = cnn.init_params(jax.random.PRNGKey(0))
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(path, {"p": params["v13"]})
+    with pytest.raises(ValueError):
+        load_checkpoint(path, {"p": params["v12"]})
+
+
+def test_checkpoint_atomic_no_partial(tmp_path):
+    # A failed save must not clobber the existing checkpoint.
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(path, {"a": np.arange(3.0)}, step=1)
+
+    class Boom:
+        pass
+
+    with pytest.raises(Exception):
+        save_checkpoint(path, {"a": Boom()})  # not array-convertible
+    tree, step, _ = load_checkpoint(path, {"a": np.zeros(3)})
+    assert step == 1
+    leftovers = [p for p in path.parent.iterdir() if ".tmp" in p.name]
+    assert not leftovers
+
+
+def test_step_timer():
+    t = StepTimer(batch_size=10, warmup=1)
+    for _ in range(4):
+        with t.step():
+            pass
+    s = t.stats()
+    assert s.steps == 3
+    assert s.images_per_sec > 0
