@@ -1,58 +1,130 @@
 """Benchmark harness — prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Headline metric (BASELINE.md north star): MNIST images/sec/chip for the
-sync strategy on real hardware. ``vs_baseline`` compares against a
-torch-CPU implementation of the same CNN + Adam step measured in-process —
-a stand-in for the reference's CPU TensorFlow runtime (the reference
+Headline metric (BASELINE.md north star): MNIST images/sec/chip for the sync
+strategy, measured through the SAME device-resident multi-step program the
+product trainers run (``lax.scan`` of train steps inside one jit), with a
+TRUE barrier (host fetch) at every timing boundary — ``block_until_ready``
+alone is not a reliable barrier on the experimental axon TPU tunnel, which
+defers execution until a fetch (round-1's 177k img/s figure measured
+dispatch rate because of this; see BASELINE.md "measurement integrity").
+
+Extras in the same JSON line: a batch-size sweep, the analytic model-FLOPs
+estimate (``train_step_flops_per_image``), and MFU vs the chip's peak.
+``vs_baseline`` compares against a torch-CPU implementation of the same
+CNN + Adam step measured in-process at the SAME batch size (200) — a
+stand-in for the reference's CPU TensorFlow runtime (the reference
 publishes no numbers, SURVEY.md §6).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
-import numpy as np
+
+# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
+_PEAK_FLOPS = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
 
 
-def bench_jax(steps: int = 60, batch: int = 200) -> float:
-    """Steady-state images/sec for the jitted train step on the default
-    platform (one real TPU chip under the driver)."""
+def _chip_peak_flops() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def train_step_flops_per_image() -> float:
+    """Analytic FLOPs model for one train step (fwd + bwd), per image.
+
+    Forward: 2 * MACs over the four convs + three FC matmuls
+    (shapes from the reference graph, mnist_sync/model/model.py:24-88);
+    backward of a conv/matmul costs ~2x its forward (dL/dx + dL/dw), so a
+    train step is ~3x forward. XLA's ``cost_analysis`` on the TPU backend
+    reports ~45x less than this (it appears to count fused MXU ops, not
+    algorithmic FLOPs), so MFU uses this model — the convention of the
+    scaling-book / MFU literature.
+    """
+    conv = lambda hw, k, cin, cout: hw * hw * k * k * cin * cout * 2
+    fwd = (
+        conv(28, 5, 1, 32)
+        + conv(14, 5, 32, 64)
+        + conv(7, 5, 64, 128)
+        + conv(4, 5, 128, 256)
+        + 2 * (1024 * 1024 + 1024 * 512 + 512 * 10)
+    )
+    return 3.0 * fwd
+
+
+def bench_jax(batch: int, steps: int = 90, chunk_steps: int = 30) -> float:
+    """Steady-state images/sec for the device-resident train program on the
+    default platform (one real TPU chip under the driver).
+
+    The program is the product path: ``chunk_steps`` train steps scanned
+    inside one jit, batches taken from a device-resident pool. One warmup
+    chunk (compile via AOT + one execution), then ``steps/chunk_steps``
+    timed chunks with a scalar fetch as the closing barrier.
+    """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from ddl_tpu.data import one_hot, synthesize
     from ddl_tpu.models import cnn
     from ddl_tpu.ops import adam_init
     from ddl_tpu.train.config import TrainConfig
-    from ddl_tpu.train.trainer import make_train_step
+    from ddl_tpu.train.trainer import force, make_train_step
 
-    x, y = synthesize(batch * 4, seed=0)
-    x = jnp.asarray(x)
-    y = jnp.asarray(one_hot(y))
+    pool = max(4, min(32, 6400 // batch))  # distinct batches resident on device
+    x, y = synthesize(pool * batch, seed=0)
+    xs = jnp.asarray(x.reshape(pool, batch, -1))
+    ys = jnp.asarray(one_hot(y).reshape(pool, batch, -1))
     cfg = TrainConfig(batch_size=batch, compute_dtype="bfloat16")
-    step = jax.jit(make_train_step(cfg), donate_argnums=(0, 1))
+    step = make_train_step(cfg)
+
+    def chunk(params, opt, xs, ys, rng_base):
+        def body(carry, i):
+            params, opt = carry
+            xb = lax.dynamic_index_in_dim(xs, i % pool, 0, keepdims=False)
+            yb = lax.dynamic_index_in_dim(ys, i % pool, 0, keepdims=False)
+            params, opt, loss = step(params, opt, xb, yb,
+                                     jax.random.fold_in(rng_base, i))
+            return (params, opt), loss
+
+        (params, opt), losses = lax.scan(body, (params, opt),
+                                         jnp.arange(chunk_steps))
+        return params, opt, losses.mean()
+
     params = cnn.init_params(jax.random.PRNGKey(0))
     opt = adam_init(params)
     rng = jax.random.PRNGKey(1)
+    fn = jax.jit(chunk, donate_argnums=(0, 1))
+    compiled = fn.lower(params, opt, xs, ys, rng).compile()
 
-    # Warmup / compile.
-    for i in range(3):
-        lo = (i % 4) * batch
-        params, opt, _ = step(params, opt, x[lo : lo + batch], y[lo : lo + batch],
-                              jax.random.fold_in(rng, i))
-    jax.block_until_ready(params)
+    # Warmup execution (also materializes the staged pool).
+    params, opt, _ = compiled(params, opt, xs, ys, rng)
+    force((params, opt))
 
+    rounds = max(1, steps // chunk_steps)
     t0 = time.perf_counter()
-    for i in range(steps):
-        lo = (i % 4) * batch
-        params, opt, _ = step(params, opt, x[lo : lo + batch], y[lo : lo + batch],
-                              jax.random.fold_in(rng, i))
-    jax.block_until_ready(params)
+    for r in range(rounds):
+        params, opt, loss = compiled(params, opt, xs, ys,
+                                     jax.random.fold_in(rng, r))
+    force((params, opt, loss))  # true barrier: forces the whole chain
     dt = time.perf_counter() - t0
-    return steps * batch / dt
+    return rounds * chunk_steps * batch / dt
 
 
 def bench_torch_cpu(steps: int = 8, batch: int = 200) -> float:
@@ -106,17 +178,38 @@ def bench_torch_cpu(steps: int = 8, batch: int = 200) -> float:
 
 
 def main() -> None:
-    jax_ips = bench_jax()
+    sweep = {}
+    repeats = 2  # the tunnel is noisy; report best-of-N capability
+    for batch in (100, 200, 500, 1000):
+        best_b = max(bench_jax(batch) for _ in range(repeats))
+        sweep[batch] = round(best_b, 1)
+        print(f"[bench] batch {batch}: {best_b:,.0f} images/s", file=sys.stderr)
+    best_batch = max(sweep, key=sweep.get)
+    best = sweep[best_batch]
+
+    flops_per_image = train_step_flops_per_image()
+    peak = _chip_peak_flops()
+    mfu_pct = (
+        round(100.0 * best * flops_per_image / peak, 2) if peak else None
+    )
+
+    # Like-for-like comparison: both arms at batch 200.
     try:
-        torch_ips = bench_torch_cpu()
-        vs = round(jax_ips / torch_ips, 2)
+        torch_ips = bench_torch_cpu(batch=200)
+        vs = round(sweep[200] / torch_ips, 2)
     except Exception:
         vs = None  # baseline unavailable — never fabricate 1.0x parity
     print(json.dumps({
         "metric": "mnist_sync_images_per_sec_per_chip",
-        "value": round(jax_ips, 1),
+        "value": round(best, 1),
         "unit": "images/s",
         "vs_baseline": vs,
+        "vs_baseline_batch": 200,
+        "batch": best_batch,
+        "sweep": sweep,
+        "flops_per_image": round(flops_per_image),
+        "mfu_pct": mfu_pct,
+        "barrier": "host-fetch (true barrier; see BASELINE.md measurement integrity)",
     }))
 
 

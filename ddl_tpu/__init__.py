@@ -18,11 +18,13 @@ semantics as XLA collectives over a `jax.sharding.Mesh`:
                              deterministic, seeded staleness schedule
 
 Layout:
-    data/      MNIST pipeline (reference model/model.py:6-14 semantics)
-    models/    pure-JAX model zoo (MNIST CNN: model/model.py:17-106)
-    ops/       optimizers + pallas kernels
-    parallel/  mesh, collectives, layout policies, strategies
-    train/     configs, trainers, metrics, checkpointing
+    data/       MNIST pipeline (reference model/model.py:6-14 semantics)
+    models/     pure-JAX model zoo (MNIST CNN: model/model.py:17-106)
+    ops/        optimizers (TF1-semantics Adam)
+    parallel/   mesh, collectives, layout policies
+    strategies/ sync (DP + ZeRO-1 sharded) and async (Hogwild PS) trainers
+    train/      config + single-chip trainer
+    utils/      metrics/profiling, checkpoint/resume
 """
 
 __version__ = "0.1.0"

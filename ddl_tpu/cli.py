@@ -56,7 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "zigzag for *_greedy; '--layout flat --num-ps "
                         "<num-workers>' is the TPU-native ZeRO-1 fast path)")
     p.add_argument("--epochs", type=int, default=1)
-    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch size (reference default 100; when "
+                        "unset, rounded up to a multiple of --num-workers "
+                        "so sharded data divides evenly)")
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--keep-prob", type=float, default=0.5)
     p.add_argument("--eval-every", type=int, default=10)
@@ -86,18 +89,39 @@ def config_from_args(args) -> "TrainConfig":
     layout = args.layout
     if layout is None:
         layout = "zigzag" if args.variant.endswith("greedy") else "block"
+    num_workers = args.num_workers or _default_workers(args.variant)
+    shard_data = not args.reference_compat
+    # Sync strategies shard the global batch over workers; validate/derive
+    # divisibility here so misconfiguration fails fast with a fix, not deep
+    # inside the trainer (the reference hardcodes batch 100 and never shards
+    # data, so it cannot hit this — worker.py:41-42).
+    batch_size = args.batch_size
+    if batch_size is None:
+        batch_size = 100
+        # Async lays data out [rounds, W, bs, ...] — bs is per-push, never
+        # split across workers — so only sync needs the divisible default.
+        if shard_data and args.variant.startswith("sync"):
+            batch_size = -(-100 // num_workers) * num_workers  # round up
+    elif (shard_data and args.variant.startswith("sync")
+          and batch_size % num_workers):
+        raise SystemExit(
+            f"--batch-size {batch_size} is not divisible by "
+            f"{num_workers} workers (data is sharded per worker). Use a "
+            f"multiple of {num_workers}, drop --batch-size to auto-round, "
+            f"or pass --reference-compat for replicated data."
+        )
     return TrainConfig(
         epochs=args.epochs,
-        batch_size=args.batch_size,
+        batch_size=batch_size,
         learning_rate=args.lr,
         keep_prob=args.keep_prob,
         eval_every=args.eval_every,
         seed=args.seed,
-        num_workers=args.num_workers or _default_workers(args.variant),
+        num_workers=num_workers,
         num_ps=args.num_ps if sharded else 1,
         layout=layout,
         grad_reduction="sum" if args.reference_compat else "mean",
-        shard_data=not args.reference_compat,
+        shard_data=shard_data,
         staleness_seed=args.staleness_seed,
         compute_dtype="bfloat16" if args.bf16 else None,
     )
@@ -158,7 +182,8 @@ def main(argv: list[str] | None = None) -> int:
 
     result = trainer.train()
     print(f"training time: {result.train_time_s:.2f}s "
-          f"({result.images_per_sec:.0f} images/s)")
+          f"({result.images_per_sec:.0f} images/s, "
+          f"compile {result.compile_time_s:.1f}s excluded)")
     if args.json:
         print(json.dumps({
             "variant": args.variant,
@@ -166,6 +191,7 @@ def main(argv: list[str] | None = None) -> int:
             "final_accuracy": result.final_accuracy,
             "train_time_s": result.train_time_s,
             "images_per_sec": result.images_per_sec,
+            "compile_time_s": result.compile_time_s,
         }))
     return 0
 

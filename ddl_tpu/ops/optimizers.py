@@ -37,8 +37,13 @@ class AdamState(NamedTuple):
 
 
 def adam_init(params: PyTree) -> AdamState:
-    zeros = jax.tree.map(jnp.zeros_like, params)
-    return AdamState(step=jnp.zeros((), jnp.int32), m=zeros, v=zeros)
+    # m and v must be distinct buffers: aliased trees break jit donation
+    # (the same buffer cannot be donated twice in one call).
+    return AdamState(
+        step=jnp.zeros((), jnp.int32),
+        m=jax.tree.map(jnp.zeros_like, params),
+        v=jax.tree.map(jnp.zeros_like, params),
+    )
 
 
 def adam_update(

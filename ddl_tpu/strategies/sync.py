@@ -52,7 +52,7 @@ from ..parallel import collectives as coll
 from ..parallel.layout import LayoutAssignment, assign_layout
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
-from ..train.trainer import TrainResult, evaluate
+from ..train.trainer import TrainResult, eval_spans, evaluate, force
 
 
 @jax.tree_util.register_dataclass
@@ -98,16 +98,9 @@ def _local_grads(config: TrainConfig, params, x, y, rng, axis: str):
     return loss, grads
 
 
-def make_dp_step(config: TrainConfig, mesh: Mesh) -> Callable:
-    """Pure sync DP (``mnist_sync`` parity): psum grads, replicated Adam.
-
-    Returns jitted ``step(params, opt_state, x, y, rng) -> (params, opt, loss)``
-    with ``x``/``y`` batch-sharded over the mesh axis (or replicated when
-    ``config.shard_data=False``, reproducing the reference's identical-batches
-    behavior, mnist_sync/worker.py:27-30).
-    """
-    W = mesh.devices.size
-    data_spec = P(DP_AXIS) if config.shard_data else P()
+def _dp_step_body(config: TrainConfig, W: int) -> Callable:
+    """Raw per-device DP step (usable inside shard_map): psum grads,
+    replicated Adam."""
     mean = config.grad_reduction == "mean"
 
     def step(params, opt_state, x, y, rng):
@@ -121,8 +114,21 @@ def make_dp_step(config: TrainConfig, mesh: Mesh) -> Callable:
         )
         return params, opt_state, loss
 
+    return step
+
+
+def make_dp_step(config: TrainConfig, mesh: Mesh) -> Callable:
+    """Pure sync DP (``mnist_sync`` parity): psum grads, replicated Adam.
+
+    Returns jitted ``step(params, opt_state, x, y, rng) -> (params, opt, loss)``
+    with ``x``/``y`` batch-sharded over the mesh axis (or replicated when
+    ``config.shard_data=False``, reproducing the reference's identical-batches
+    behavior, mnist_sync/worker.py:27-30).
+    """
+    W = mesh.devices.size
+    data_spec = P(DP_AXIS) if config.shard_data else P()
     smapped = jax.shard_map(
-        step,
+        _dp_step_body(config, W),
         mesh=mesh,
         in_specs=(P(), P(), data_spec, data_spec, P()),
         out_specs=(P(), P(), P()),
@@ -150,8 +156,26 @@ def make_sharded_step(
     with ``psum`` then slice the unequal owner range (padded to max_shard).
     """
     W = mesh.devices.size
-    spec = coll.FlatSpec.from_layout(layout, shapes or dict(cnn.PARAM_SPECS))
+    step = _sharded_step_body(config, W, layout, shapes)
     data_spec = P(DP_AXIS) if config.shard_data else P()
+    smapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), data_spec, data_spec, P()),
+        out_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=donation_for(mesh, 0, 1))
+
+
+def _sharded_step_body(
+    config: TrainConfig,
+    W: int,
+    layout: LayoutAssignment,
+    shapes: Mapping[str, tuple[int, ...]] | None = None,
+) -> Callable:
+    """Raw per-device ZeRO-1 step (usable inside shard_map)."""
+    spec = coll.FlatSpec.from_layout(layout, shapes or dict(cnn.PARAM_SPECS))
     mean = config.grad_reduction == "mean"
     # The fused psum_scatter path needs one equal chunk per mesh device.
     equal_chunks = layout.policy == "flat" and layout.num_shards == W
@@ -195,11 +219,64 @@ def make_sharded_step(
             full = gathered[jnp.asarray(reassembly)]
         return coll.unflatten_params(full, spec), opt, loss
 
+    return step
+
+
+def make_sync_epoch(
+    config: TrainConfig,
+    mesh: Mesh,
+    layout: LayoutAssignment | None,
+    shapes: Mapping[str, tuple[int, ...]] | None,
+    k: int,
+) -> Callable:
+    """Device-resident multi-step sync program: ``k`` consecutive batches in
+    ONE compiled dispatch (``lax.scan`` inside the shard_map), replacing the
+    reference's per-batch host round-trips (mnist_sync/worker.py:60-72).
+
+    Returns jitted ``run(params, opt, xs, ys, first, goff, rng_base) ->
+    (params, opt, mean_loss)`` where ``xs``/``ys`` hold the FULL epoch:
+
+    - sharded data: ``[W, B, bs/W, ...]`` placed ``P(DP_AXIS)`` — worker w's
+      slice of every batch lives on device w for the whole epoch;
+    - replicated data (``shard_data=False`` compat): ``[B, bs, ...]``, ``P()``.
+
+    ``first`` is the span's first batch index and ``goff`` the global step
+    offset feeding the dropout stream (identical streams to the per-step
+    path, so device-resident training is bit-compatible with it).
+    """
+    W = mesh.devices.size
+    if layout is None:
+        step = _dp_step_body(config, W)
+        opt_spec: Any = P()
+    else:
+        step = _sharded_step_body(config, W, layout, shapes)
+        opt_spec = ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS))
+    data_spec = P(DP_AXIS) if config.shard_data else P()
+
+    def run(params, opt_state, xs, ys, first, goff, rng_base):
+        def body(carry, i):
+            params, opt_state = carry
+            if config.shard_data:
+                # Local view [1, B, bs/W, ...] -> this device's batch slice.
+                x = lax.dynamic_index_in_dim(xs[0], first + i, 0, keepdims=False)
+                y = lax.dynamic_index_in_dim(ys[0], first + i, 0, keepdims=False)
+            else:
+                x = lax.dynamic_index_in_dim(xs, first + i, 0, keepdims=False)
+                y = lax.dynamic_index_in_dim(ys, first + i, 0, keepdims=False)
+            rng = jax.random.fold_in(rng_base, goff + i)
+            params, opt_state, loss = step(params, opt_state, x, y, rng)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), jnp.arange(k)
+        )
+        return params, opt_state, losses.mean()
+
     smapped = jax.shard_map(
-        step,
+        run,
         mesh=mesh,
-        in_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), data_spec, data_spec, P()),
-        out_specs=(P(), ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS)), P()),
+        in_specs=(P(), opt_spec, data_spec, data_spec, P(), P(), P()),
+        out_specs=(P(), opt_spec, P()),
         check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=donation_for(mesh, 0, 1))
@@ -244,8 +321,11 @@ def resolve_layout(
 
 
 class SyncTrainer:
-    """Drives any sync strategy over an epoch loop with the reference's
-    eval-every-10-batches cadence (mnist_sync/worker.py:71-72)."""
+    """Drives any sync strategy device-resident: the epoch's data is staged
+    on the mesh once (each worker's slice of every batch resident on its
+    device) and each eval span runs as one compiled multi-step program
+    (``make_sync_epoch``), with the reference's eval-every-10-batches
+    cadence (mnist_sync/worker.py:71-72) on the host side."""
 
     def __init__(
         self,
@@ -263,57 +343,104 @@ class SyncTrainer:
         key = jax.random.PRNGKey(config.seed)
         self.init_key, self.dropout_key = jax.random.split(key)
         params = init if init is not None else cnn.init_params(self.init_key)
-        shapes = cnn.param_shapes(params)
-        sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
+        self._shapes = cnn.param_shapes(params)
+        sizes = {k: int(np.prod(s)) if s else 1 for k, s in self._shapes.items()}
         self.layout = resolve_layout(config, W, sizes)
         self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
         if self.layout is None:
             self.opt_state: Any = jax.device_put(
                 adam_init(params), NamedSharding(self.mesh, P())
             )
-            self._step = make_dp_step(config, self.mesh)
         else:
             self.opt_state = sharded_adam_init(self.mesh, self.layout)
-            self._step = make_sharded_step(config, self.mesh, self.layout, shapes)
+        self._chunks: dict[int, Callable] = {}
+
+    def _chunk_fn(self, k: int) -> Callable:
+        if k not in self._chunks:
+            self._chunks[k] = make_sync_epoch(
+                self.config, self.mesh, self.layout, self._shapes, k
+            )
+        return self._chunks[k]
+
+    def _stage_epoch(self, batch_num: int) -> tuple[jax.Array, jax.Array]:
+        """Stage the epoch on the mesh: sharded -> ``[W, B, bs/W, ...]`` with
+        worker w's slice of every batch on device w; replicated compat
+        stream -> ``[B, bs, ...]`` everywhere."""
+        cfg = self.config
+        ds = self.dataset
+        W = self.mesh.devices.size
+        bs = cfg.batch_size
+        n = batch_num * bs
+        x = np.asarray(ds.x_train)[:n]
+        y = one_hot(ds.y_train)[:n]
+        # Explicit feature dims: batch_num may be 0 (dataset < one global
+        # batch), where reshape(-1) inference fails — zero batches stages
+        # empty arrays and the span loop runs zero steps.
+        fx, fy = x.shape[-1], y.shape[-1]
+        if cfg.shard_data:
+            pb = cfg.per_worker_batch()
+            xs = np.ascontiguousarray(
+                x.reshape(batch_num, W, pb, fx).transpose(1, 0, 2, 3)
+            )
+            ys = np.ascontiguousarray(
+                y.reshape(batch_num, W, pb, fy).transpose(1, 0, 2, 3)
+            )
+            sharding = NamedSharding(self.mesh, P(DP_AXIS))
+        else:
+            xs = x.reshape(batch_num, bs, fx)
+            ys = y.reshape(batch_num, bs, fy)
+            sharding = NamedSharding(self.mesh, P())
+        return jax.device_put(xs, sharding), jax.device_put(ys, sharding)
 
     def train(self, log: Callable[[str], None] = print) -> TrainResult:
         cfg = self.config
         ds = self.dataset
-        x_train = np.asarray(ds.x_train)
-        y_train = one_hot(ds.y_train)
+        batch_num = ds.num_train // cfg.batch_size
+        xs, ys = self._stage_epoch(batch_num)
         x_test = jnp.asarray(ds.x_test)
         y_test = jnp.asarray(one_hot(ds.y_test))
-        data_sharding = NamedSharding(
-            self.mesh, P(DP_AXIS) if cfg.shard_data else P()
-        )
 
-        params, opt_state = self.params, self.opt_state
-        # Global batch per step; when data is sharded each device sees
-        # batch_size/W examples (per_worker_batch validates divisibility).
-        if cfg.shard_data:
-            cfg.per_worker_batch()
-        batch_num = ds.num_train // cfg.batch_size
+        # Fresh buffers: the chunk programs donate params/opt (on TPU), which
+        # must never consume arrays the caller still owns.
+        params = jax.tree.map(jnp.copy, self.params)
+        opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        # Materialize staged data + state BEFORE the clock starts: transfers
+        # are async (and lazy on the tunnel backend); steady-state throughput
+        # must not absorb the host->HBM upload of the train set.
+        force((xs, ys, params, opt_state), all_leaves=True)
+        spans = eval_spans(batch_num, cfg.eval_every)
         history: list[tuple[int, int, float]] = []
+        # AOT-compile every span program outside the timed region (first TPU
+        # compile is tens of seconds; steady-state throughput must not absorb
+        # it). ``lower().compile()`` does not execute anything.
+        t0 = time.perf_counter()
+        args0 = (jnp.int32(0), jnp.int32(0), self.dropout_key)
+        fns = {
+            k: self._chunk_fn(k).lower(params, opt_state, xs, ys, *args0).compile()
+            for k in {k for _, k, _ in spans}
+        }
+        compile_time = time.perf_counter() - t0
         images = 0
         train_time = 0.0
         start = time.perf_counter()
         seg = start
         for epoch in range(cfg.epochs):
-            for cnt in range(batch_num):
-                lo, hi = cfg.batch_size * cnt, cfg.batch_size * (cnt + 1)
-                xb = jax.device_put(x_train[lo:hi], data_sharding)
-                yb = jax.device_put(y_train[lo:hi], data_sharding)
-                rng = jax.random.fold_in(self.dropout_key, epoch * batch_num + cnt)
-                params, opt_state, _ = self._step(params, opt_state, xb, yb, rng)
-                images += cfg.batch_size
-                if cfg.eval_every and cnt % cfg.eval_every == 0:
-                    jax.block_until_ready(params)
+            for first, k, eval_after in spans:
+                params, opt_state, _ = fns[k](
+                    params, opt_state, xs, ys,
+                    jnp.int32(first), jnp.int32(epoch * batch_num + first),
+                    self.dropout_key,
+                )
+                images += k * cfg.batch_size
+                if eval_after:
+                    force(params)
                     train_time += time.perf_counter() - seg
+                    cnt = first + k - 1
                     acc = evaluate(params, x_test, y_test)
                     history.append((epoch, cnt, acc))
                     log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                     seg = time.perf_counter()
-        jax.block_until_ready(params)
+        force(params)
         end = time.perf_counter()
         train_time += end - seg
         final_acc = evaluate(params, x_test, y_test)
@@ -326,4 +453,5 @@ class SyncTrainer:
             train_time_s=train_time,
             history=history,
             images_per_sec=images / train_time if train_time > 0 else 0.0,
+            compile_time_s=compile_time,
         )

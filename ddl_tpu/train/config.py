@@ -43,7 +43,7 @@ class TrainConfig:
     num_ps: int = 1  # parameter-shard count (sharded strategies)
 
     # Strategy knobs.
-    layout: Literal["block", "zigzag", "lpt"] = "block"
+    layout: Literal["block", "zigzag", "lpt", "flat"] = "block"
     grad_reduction: Literal["mean", "sum"] = "mean"
     shard_data: bool = True
 

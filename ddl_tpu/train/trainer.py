@@ -2,10 +2,13 @@
 
 The reference baseline (mnist_sync/single.py:10-21) runs sequential
 mini-batches through the graph's own ``train_step``, printing full-test-set
-accuracy every 10 batches and at exit. This trainer reproduces that loop as
-one jit-compiled XLA program per step (grad + Adam fused, no per-variable
-Python round-trips), and is the numerical oracle the distributed strategies
-are tested against.
+accuracy every 10 batches and at exit. This trainer reproduces that loop
+**device-resident**: the full epoch's data is staged on device once, and a
+``lax.scan`` advances ``eval_every`` consecutive steps inside ONE compiled
+XLA program — the host is only involved at eval points. (The reference pays
+a ``sess.run`` plus 14 per-variable Python round-trips per batch,
+worker.py:35-36; here a 10-batch span is a single dispatch.) It is also the
+numerical oracle the distributed strategies are tested against.
 """
 
 from __future__ import annotations
@@ -29,9 +32,10 @@ class TrainResult:
     params: dict
     final_accuracy: float
     wall_time_s: float  # total, including periodic evals (reference-style)
-    train_time_s: float  # step time only, evals excluded
+    train_time_s: float  # step time only; evals and XLA compilation excluded
     history: list[tuple[int, int, float]]  # (epoch, batch, accuracy)
     images_per_sec: float  # images / train_time_s
+    compile_time_s: float = 0.0  # AOT compilation of the epoch programs
 
 
 def make_train_step(
@@ -58,6 +62,88 @@ def make_train_step(
     return step
 
 
+def force(tree, *, all_leaves: bool = False) -> None:
+    """True timing barrier: materialize the computation behind ``tree``.
+
+    ``jax.block_until_ready`` is not a reliable barrier on every PJRT
+    backend (the experimental axon TPU tunnel defers execution until a host
+    fetch, so block returns immediately). Fetching a scalar element forces
+    the producing executable to run — and with it every other output of the
+    same execution.
+
+    Default: fetch from the FIRST leaf only — correct (and one round-trip
+    cheap) when ``tree`` is the output of a single executable, i.e. every
+    timed-loop boundary. ``all_leaves=True`` fetches one scalar per leaf —
+    needed when leaves come from independent dispatches (staged uploads,
+    per-leaf ``jnp.copy`` trees); use it outside timed regions, since each
+    fetch costs a host round-trip.
+    """
+    leaves = [
+        l for l in jax.tree_util.tree_leaves(tree)
+        if hasattr(l, "ndim") and getattr(l, "size", 0)
+    ]
+    picked = leaves if all_leaves else leaves[:1]
+    scalars = [leaf[(0,) * leaf.ndim] for leaf in picked]
+    for s in scalars:
+        np.asarray(s)
+    jax.block_until_ready(leaves)
+
+
+def eval_spans(batch_num: int, eval_every: int) -> list[tuple[int, int, bool]]:
+    """Chunk an epoch into ``(first_batch, num_batches, eval_after)`` spans.
+
+    Span boundaries are the reference's eval points: accuracy is printed
+    after every batch ``cnt`` with ``cnt % eval_every == 0``
+    (mnist_sync/worker.py:71-72), i.e. after batches 0, 10, 20, ... — so the
+    spans are [0], [1..10], [11..20], ..., plus a no-eval tail. Each span
+    becomes ONE compiled multi-step program (at most three distinct lengths
+    -> at most three XLA compilations per trainer).
+    """
+    if batch_num <= 0:
+        return []
+    if not eval_every:
+        return [(0, batch_num, False)]
+    spans = []
+    first = 0
+    while first < batch_num:
+        k = 1 if first == 0 else min(eval_every, batch_num - first)
+        last = first + k - 1
+        spans.append((first, k, last % eval_every == 0))
+        first += k
+    return spans
+
+
+def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
+    """The single-chip device-resident multi-step program, shared by
+    ``SingleChipTrainer`` and ``bench.py`` (so the benchmark measures the
+    product path by construction).
+
+    Jitted ``(params, opt, xs, ys, first, goff, rng_base) ->
+    (params, opt, mean_loss)`` advancing ``k`` consecutive batches.
+    ``xs``/``ys`` are device-resident ``[B, bs, ...]``; ``first`` is the
+    first batch index (traced — one compilation per distinct ``k``) and
+    ``goff`` the global step offset feeding the dropout stream (identical
+    stream to a per-step loop, so span chunking never changes numerics).
+    """
+    step = make_train_step(config)
+
+    def chunk(params, opt_state, xs, ys, first, goff, rng_base):
+        def body(carry, i):
+            params, opt_state = carry
+            x = jax.lax.dynamic_index_in_dim(xs, first + i, 0, keepdims=False)
+            y = jax.lax.dynamic_index_in_dim(ys, first + i, 0, keepdims=False)
+            rng = jax.random.fold_in(rng_base, goff + i)
+            params, opt_state, loss = step(params, opt_state, x, y, rng)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), jnp.arange(k)
+        )
+        return params, opt_state, losses.mean()
+
+    return jax.jit(chunk, donate_argnums=(0, 1))
+
+
 # Module-level so the jit cache is shared across evaluate() calls.
 _jit_accuracy = jax.jit(cnn.accuracy)
 
@@ -78,7 +164,9 @@ def evaluate(
 
 
 class SingleChipTrainer:
-    """`single.py`-equivalent training on one device."""
+    """`single.py`-equivalent training on one device, device-resident:
+    the train set is staged on device once and each eval span runs as one
+    ``lax.scan`` inside one jit (see module docstring)."""
 
     def __init__(self, config: TrainConfig, dataset: Dataset, init: dict | None = None):
         self.config = config
@@ -89,40 +177,76 @@ class SingleChipTrainer:
         self.init_key, self.dropout_key = jax.random.split(key)
         self.params = init if init is not None else cnn.init_params(self.init_key)
         self.opt_state = adam_init(self.params)
-        self._step = jax.jit(make_train_step(config))
+        self._chunks: dict[int, Callable] = {}
+
+    def _chunk_fn(self, k: int) -> Callable:
+        """Cached :func:`make_epoch_chunk` program for span length ``k``."""
+        if k not in self._chunks:
+            self._chunks[k] = make_epoch_chunk(self.config, k)
+        return self._chunks[k]
 
     def train(self, log: Callable[[str], None] = print) -> TrainResult:
         cfg = self.config
-        x_train = jnp.asarray(self.dataset.x_train)
-        y_train = jnp.asarray(self.y_train_onehot)
+        batch_num = self.dataset.num_train // cfg.batch_size
+        n = batch_num * cfg.batch_size
+        # Sequential batching, no shuffle — reference semantics
+        # (single.py:14-15 slices [bs*cnt : bs*(cnt+1)] in order). Feature
+        # dims are explicit so batch_num=0 (dataset < one batch) stages
+        # empty arrays instead of failing reshape inference — the old
+        # per-batch loop ran zero steps in that case, and so does this.
+        x_np = np.asarray(self.dataset.x_train)
+        xs = jnp.asarray(
+            x_np[:n].reshape(batch_num, cfg.batch_size, x_np.shape[-1])
+        )
+        ys = jnp.asarray(
+            self.y_train_onehot[:n].reshape(
+                batch_num, cfg.batch_size, self.y_train_onehot.shape[-1]
+            )
+        )
         x_test = jnp.asarray(self.dataset.x_test)
         y_test = jnp.asarray(self.y_test_onehot)
 
-        params, opt_state = self.params, self.opt_state
+        # Fresh buffers: the chunk programs donate params/opt, which must
+        # never consume arrays the caller still owns (e.g. a shared init).
+        params = jax.tree.map(jnp.copy, self.params)
+        opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        # Materialize staged data + state BEFORE the clock starts: transfers
+        # are async (and lazy on the tunnel backend); steady-state throughput
+        # must not absorb the host->HBM upload of the train set.
+        force((xs, ys, params, opt_state), all_leaves=True)
         history: list[tuple[int, int, float]] = []
-        batch_num = self.dataset.num_train // cfg.batch_size
+        spans = eval_spans(batch_num, cfg.eval_every)
+        # AOT-compile every span program outside the timed region (first TPU
+        # compile is tens of seconds; steady-state throughput must not absorb
+        # it). ``lower().compile()`` does not execute anything.
+        t0 = time.perf_counter()
+        args0 = (jnp.int32(0), jnp.int32(0), self.dropout_key)
+        fns = {
+            k: self._chunk_fn(k).lower(params, opt_state, xs, ys, *args0).compile()
+            for k in {k for _, k, _ in spans}
+        }
+        compile_time = time.perf_counter() - t0
         images = 0
         train_time = 0.0
         start = time.perf_counter()
         segment_start = start
         for epoch in range(cfg.epochs):
-            for cnt in range(batch_num):
-                # Sequential slicing, no shuffle — reference semantics
-                # (single.py:14-15 slices [bs*cnt : bs*(cnt+1)] in order).
-                lo, hi = cfg.batch_size * cnt, cfg.batch_size * (cnt + 1)
-                rng = jax.random.fold_in(self.dropout_key, epoch * batch_num + cnt)
-                params, opt_state, _ = self._step(
-                    params, opt_state, x_train[lo:hi], y_train[lo:hi], rng
+            for first, k, eval_after in spans:
+                params, opt_state, _ = fns[k](
+                    params, opt_state, xs, ys,
+                    jnp.int32(first), jnp.int32(epoch * batch_num + first),
+                    self.dropout_key,
                 )
-                images += cfg.batch_size
-                if cfg.eval_every and cnt % cfg.eval_every == 0:
-                    jax.block_until_ready(params)
+                images += k * cfg.batch_size
+                if eval_after:
+                    force(params)
                     train_time += time.perf_counter() - segment_start
+                    cnt = first + k - 1
                     acc = evaluate(params, x_test, y_test)
                     history.append((epoch, cnt, acc))
                     log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                     segment_start = time.perf_counter()
-        jax.block_until_ready(params)
+        force(params)
         end = time.perf_counter()
         train_time += end - segment_start
         wall = end - start
@@ -136,4 +260,5 @@ class SingleChipTrainer:
             train_time_s=train_time,
             history=history,
             images_per_sec=images / train_time if train_time > 0 else 0.0,
+            compile_time_s=compile_time,
         )

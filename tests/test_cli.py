@@ -51,3 +51,21 @@ def test_reference_hyperparameter_defaults():
 def test_bf16_flag():
     assert _cfg(["single", "--bf16"]).compute_dtype == "bfloat16"
     assert _cfg(["single"]).compute_dtype is None
+
+
+def test_default_batch_rounds_to_worker_multiple():
+    # ADVICE r1: `sync --num-workers 8` must not crash on 100 % 8 != 0.
+    cfg = _cfg(["sync", "--num-workers", "8"])
+    assert cfg.batch_size == 104
+    assert cfg.per_worker_batch() == 13
+    # Explicit divisible batch is honored verbatim.
+    assert _cfg(["sync", "--num-workers", "8", "--batch-size", "200"]).batch_size == 200
+    # Compat stream replicates data — the reference batch stays exactly 100.
+    assert _cfg(["sync", "--num-workers", "8", "--reference-compat"]).batch_size == 100
+
+
+def test_explicit_indivisible_batch_fails_fast():
+    import pytest
+
+    with pytest.raises(SystemExit, match="not divisible"):
+        _cfg(["sync", "--num-workers", "8", "--batch-size", "100"])

@@ -8,6 +8,7 @@ import jax
 import numpy as np
 
 from ddl_tpu.train import SingleChipTrainer, TrainConfig
+from ddl_tpu.train.trainer import eval_spans
 
 
 def test_trains_and_converges(small_dataset, small_params):
@@ -38,3 +39,30 @@ def test_eval_history(small_dataset, small_params):
     )
     batches = [b for _, b, _ in result.history]
     assert batches == [0, 4]  # 2048/256 = 8 batches -> evals at 0 and 4
+
+
+def test_eval_spans():
+    # Reference cadence: eval after every batch cnt % eval_every == 0
+    # (worker.py:71-72) -> spans [0], [1..10], ..., no-eval tail.
+    spans = eval_spans(25, 10)
+    assert spans == [(0, 1, True), (1, 10, True), (11, 10, True), (21, 4, False)]
+    assert eval_spans(500, 10)[-1] == (491, 9, False)
+    assert eval_spans(8, 0) == [(0, 8, False)]  # eval_every=0: one chunk
+    assert eval_spans(0, 10) == []
+    # Total batches covered == batch_num, no overlaps.
+    for bn, ee in [(500, 10), (7, 3), (1, 10), (13, 1)]:
+        sp = eval_spans(bn, ee)
+        assert sum(k for _, k, _ in sp) == bn
+        assert [f for f, _, _ in sp] == list(
+            np.cumsum([0] + [k for _, k, _ in sp[:-1]])
+        )
+
+
+def test_multiple_train_calls_do_not_invalidate_state(small_dataset, small_params):
+    # The chunk programs donate params/opt; train() must copy first so the
+    # trainer (and any shared init tree) survives repeated calls.
+    cfg = TrainConfig(epochs=1, batch_size=512, eval_every=0, seed=1)
+    trainer = SingleChipTrainer(cfg, small_dataset, init=small_params)
+    trainer.train(log=lambda s: None)
+    trainer.train(log=lambda s: None)  # would raise if buffers were donated
+    np.asarray(small_params["v0"])  # shared init still alive
