@@ -73,13 +73,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="procedural test-set size when --data is absent")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (MXU fast path)")
+    p.add_argument("--conv-channels", type=_int_tuple, default=None,
+                   metavar="C1,C2,C3,C4",
+                   help="conv widths of the model family (default "
+                        "32,64,128,256 — the reference architecture)")
+    p.add_argument("--fc-sizes", type=_int_tuple, default=None,
+                   metavar="F1,F2",
+                   help="FC widths of the model family (default 1024,512)")
+    p.add_argument("--tiny", action="store_true",
+                   help="narrow model preset (--conv-channels 4,8,8,8 "
+                        "--fc-sizes 32,16): structurally identical 14-var "
+                        "model at ~1/400 the FLOPs, for smoke runs and CI")
     p.add_argument("--reference-compat", action="store_true",
                    help="reproduce the reference's accidental semantics: "
                         "summed (not averaged) gradients and identical "
                         "batches on every worker")
     p.add_argument("--json", action="store_true",
                    help="emit a single JSON result line at exit")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform before backend init (the TPU "
+                        "tunnel's sitecustomize overrides JAX_PLATFORMS, so "
+                        "an env var cannot; '--platform cpu' gives a "
+                        "hermetic virtual mesh for CI and smoke runs)")
     return p
+
+
+def _int_tuple(text: str) -> tuple[int, ...]:
+    try:
+        return tuple(int(t) for t in text.split(","))
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated ints, got {text!r}"
+        )
 
 
 def config_from_args(args) -> "TrainConfig":
@@ -102,6 +127,9 @@ def config_from_args(args) -> "TrainConfig":
         # split across workers — so only sync needs the divisible default.
         if shard_data and args.variant.startswith("sync"):
             batch_size = -(-100 // num_workers) * num_workers  # round up
+            if batch_size != 100:
+                print(f"[ddl_tpu] batch size 100 -> {batch_size} "
+                      f"(divisible by {num_workers} workers)")
     elif (shard_data and args.variant.startswith("sync")
           and batch_size % num_workers):
         raise SystemExit(
@@ -110,6 +138,17 @@ def config_from_args(args) -> "TrainConfig":
             f"multiple of {num_workers}, drop --batch-size to auto-round, "
             f"or pass --reference-compat for replicated data."
         )
+    conv_channels = args.conv_channels
+    fc_sizes = args.fc_sizes
+    if args.tiny:
+        conv_channels = conv_channels or (4, 8, 8, 8)
+        fc_sizes = fc_sizes or (32, 16)
+    if conv_channels is not None and (
+        len(conv_channels) != 4 or min(conv_channels) < 1
+    ):
+        raise SystemExit("--conv-channels takes exactly 4 positive widths")
+    if fc_sizes is not None and (len(fc_sizes) != 2 or min(fc_sizes) < 1):
+        raise SystemExit("--fc-sizes takes exactly 2 positive widths")
     return TrainConfig(
         epochs=args.epochs,
         batch_size=batch_size,
@@ -124,6 +163,8 @@ def config_from_args(args) -> "TrainConfig":
         shard_data=shard_data,
         staleness_seed=args.staleness_seed,
         compute_dtype="bfloat16" if args.bf16 else None,
+        conv_channels=conv_channels or (32, 64, 128, 256),
+        fc_sizes=fc_sizes or (1024, 512),
     )
 
 
@@ -132,20 +173,35 @@ def _default_workers(variant: str) -> int:
         return 1
     import jax
 
-    return len(jax.devices())
+    try:
+        return len(jax.devices())
+    except RuntimeError as e:
+        raise SystemExit(
+            f"could not initialize the default JAX platform ({e}); "
+            "pass --platform cpu for a virtual mesh"
+        )
 
 
-def _ensure_devices(n: int) -> None:
+def _ensure_devices(n: int, *, allow_fallback: bool = True) -> None:
     """If the active platform has fewer than ``n`` devices (e.g. one real
     TPU chip), fall back to a virtual n-device CPU mesh so every strategy
-    is runnable anywhere."""
+    is runnable anywhere. With ``allow_fallback=False`` (the user passed an
+    explicit ``--platform``) a shortfall is an error, never a silent
+    platform swap."""
     import jax
 
+    err = None
     try:
         if len(jax.devices()) >= n:
             return
-    except RuntimeError:
-        pass
+    except RuntimeError as e:
+        err = e
+    if not allow_fallback:
+        have = "unavailable" if err is not None else f"{len(jax.devices())} devices"
+        raise SystemExit(
+            f"requested platform cannot provide {n} devices ({have}); "
+            "drop --platform to allow the virtual-CPU-mesh fallback"
+        )
     import jax.extend.backend as jeb
 
     jeb.clear_backends()
@@ -156,6 +212,12 @@ def _ensure_devices(n: int) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu":
+            jax.config.update("jax_num_cpu_devices", max(args.num_workers or 8, 8))
     from .data import load_mnist
 
     dataset = load_mnist(
@@ -165,7 +227,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     cfg = config_from_args(args)
     if args.variant != "single":
-        _ensure_devices(cfg.num_workers)
+        _ensure_devices(cfg.num_workers, allow_fallback=args.platform is None)
 
     if args.variant == "single":
         from .train.trainer import SingleChipTrainer

@@ -335,7 +335,10 @@ class AsyncTrainer:
             )
         key = jax.random.PRNGKey(config.seed)
         self.init_key, self.dropout_key = jax.random.split(key)
-        params = init if init is not None else cnn.init_params(self.init_key)
+        params = (
+            init if init is not None
+            else cnn.init_params(self.init_key, specs=config.model_specs())
+        )
         shapes = cnn.param_shapes(params)
         sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
         self.layout = resolve_layout(config, W, sizes)

@@ -342,7 +342,10 @@ class SyncTrainer:
             raise ValueError(f"mesh has {W} devices, config.num_workers={config.num_workers}")
         key = jax.random.PRNGKey(config.seed)
         self.init_key, self.dropout_key = jax.random.split(key)
-        params = init if init is not None else cnn.init_params(self.init_key)
+        params = (
+            init if init is not None
+            else cnn.init_params(self.init_key, specs=config.model_specs())
+        )
         self._shapes = cnn.param_shapes(params)
         sizes = {k: int(np.prod(s)) if s else 1 for k, s in self._shapes.items()}
         self.layout = resolve_layout(config, W, sizes)

@@ -57,6 +57,22 @@ class TrainConfig:
     # None = fp32 (reference parity); "bfloat16" engages the MXU fast path.
     compute_dtype: str | None = None
 
+    # Model family: widths of the reference CNN architecture (defaults
+    # reproduce the reference exactly — mnist_sync/model/model.py:24-88).
+    # Narrower widths give a structurally identical 14-variable model at a
+    # fraction of the FLOPs (CI-affordable end-to-end runs).
+    conv_channels: tuple[int, int, int, int] = (32, 64, 128, 256)
+    fc_sizes: tuple[int, int] = (1024, 512)
+
+    def model_specs(self):
+        """(name, shape) specs for this config's model-family instance."""
+        from ..models import cnn
+
+        return cnn.make_param_specs(
+            conv_channels=tuple(self.conv_channels),
+            fc_sizes=tuple(self.fc_sizes),
+        )
+
     def per_worker_batch(self) -> int:
         if self.batch_size % self.num_workers:
             raise ValueError(

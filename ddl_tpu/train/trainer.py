@@ -175,7 +175,10 @@ class SingleChipTrainer:
         self.y_test_onehot = one_hot(dataset.y_test)
         key = jax.random.PRNGKey(config.seed)
         self.init_key, self.dropout_key = jax.random.split(key)
-        self.params = init if init is not None else cnn.init_params(self.init_key)
+        self.params = (
+            init if init is not None
+            else cnn.init_params(self.init_key, specs=config.model_specs())
+        )
         self.opt_state = adam_init(self.params)
         self._chunks: dict[int, Callable] = {}
 
