@@ -88,6 +88,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reproduce the reference's accidental semantics: "
                         "summed (not averaged) gradients and identical "
                         "batches on every worker")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save an atomic rolling checkpoint (params + "
+                        "optimizer state) at every epoch end — the "
+                        "persistence the reference lacks entirely "
+                        "(params die with the TF session, model.py:109-112)")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="additionally checkpoint every N batches "
+                        "(async: N rounds); 0 = epoch end only")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the checkpoint in --checkpoint-dir "
+                        "(missing checkpoint starts fresh)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the training loop "
+                        "into DIR (view in TensorBoard/Perfetto)")
     p.add_argument("--json", action="store_true",
                    help="emit a single JSON result line at exit")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
@@ -242,10 +256,19 @@ def main(argv: list[str] | None = None) -> int:
 
         trainer = AsyncTrainer(cfg, dataset)
 
-    result = trainer.train()
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    result = trainer.train(
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+        profile_dir=args.profile,
+    )
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")
+    if result.step_stats and result.step_stats.steps:
+        print(f"step stats (per dispatched span): {result.step_stats.line()}")
     if args.json:
         print(json.dumps({
             "variant": args.variant,
@@ -254,6 +277,9 @@ def main(argv: list[str] | None = None) -> int:
             "train_time_s": result.train_time_s,
             "images_per_sec": result.images_per_sec,
             "compile_time_s": result.compile_time_s,
+            "step_stats": dataclasses.asdict(result.step_stats)
+                          if result.step_stats else None,
+            "resumed_from_step": result.resumed_from_step,
         }))
     return 0
 

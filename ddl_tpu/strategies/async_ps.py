@@ -57,7 +57,16 @@ from ..parallel import collectives as coll
 from ..parallel.layout import LayoutAssignment
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
-from ..train.trainer import TrainResult, evaluate, force
+from ..train.trainer import (
+    TrainResult,
+    checkpoint_file,
+    evaluate,
+    force,
+    save_crossed,
+    try_resume,
+)
+from ..utils.checkpoint import save_checkpoint
+from ..utils.metrics import StepTimer, trace
 from ..parallel.layout import assign_layout
 from .sync import resolve_layout
 
@@ -377,7 +386,33 @@ class AsyncTrainer:
             )
         return np.ascontiguousarray(xs), np.ascontiguousarray(ys), rounds
 
-    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+    def _gather_ps(self, state: AsyncState) -> jax.Array:
+        """Authoritative flat param vector from the PS state: the owner-major
+        chunks reassembled to flat (layout) order when sharded."""
+        if self.layout is None:
+            return state.ps
+        flat = np.asarray(state.ps)  # host gather of [W * chunk]
+        return jnp.asarray(flat[coll.reassembly_index(self.layout)])
+
+    def _place_state(self, state: AsyncState) -> AsyncState:
+        """Re-place host (checkpoint) state onto this trainer's shardings."""
+        rep = NamedSharding(self.mesh, P())
+        sh = rep if self.layout is None else NamedSharding(self.mesh, P(DP_AXIS))
+        put = lambda a, s: jax.device_put(jnp.asarray(a), s)
+        return AsyncState(
+            ps=put(state.ps, sh), m=put(state.m, sh), v=put(state.v, sh),
+            workers=put(state.workers, sh), t=put(state.t, rep),
+        )
+
+    def train(
+        self,
+        log: Callable[[str], None] = print,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        profile_dir: str | None = None,
+    ) -> TrainResult:
         cfg = self.config
         W = cfg.num_workers
         xs_all, ys_all, rounds = self._batches()
@@ -390,6 +425,10 @@ class AsyncTrainer:
         # Fresh buffers: the round program donates the state (on TPU), which
         # must never consume arrays the caller still owns.
         state = jax.tree.map(jnp.copy, self.state)
+        ckpt = checkpoint_file(checkpoint_dir)
+        tree, start_round = try_resume(ckpt, resume, {"state": state}, log)
+        if tree is not None:
+            state = self._place_state(tree["state"])
         # Stage the full epoch on the mesh once, BEFORE the clock starts
         # (transfers are async/lazy; slicing device-resident rounds is free
         # and keeps the sharding).
@@ -399,50 +438,61 @@ class AsyncTrainer:
         history: list[tuple[int, int, float]] = []
         chunk_rounds = cfg.eval_every if cfg.eval_every else rounds
         images_per_round = cfg.batch_size * W  # W pushes of one batch each
-        images = 0
-        train_time = 0.0
-        compile_time = 0.0
+        chunks = [
+            (lo, min(lo + chunk_rounds, rounds))
+            for lo in range(0, rounds, chunk_rounds)
+        ]
+        # AOT-compile every chunk length outside the timed region (symmetric
+        # with the sync trainers — no lazy compile inside the clock).
+        t0 = time.perf_counter()
         compiled: dict[int, Callable] = {}
+        for lo, hi in chunks:
+            L = hi - lo
+            if L not in compiled:
+                rngs0 = jnp.zeros((L, 2), jnp.uint32)
+                sched0 = jnp.zeros((L, W), jnp.int32)
+                compiled[L] = self._run.lower(
+                    state, xs_dev[lo:hi], ys_dev[lo:hi], rngs0, sched0
+                ).compile()
+        compile_time = time.perf_counter() - t0
+        timer = StepTimer()
         start = time.perf_counter()
-        seg = start
         ps_full = None
-        for epoch in range(cfg.epochs):
-            scheds = async_schedule(cfg.staleness_seed + epoch, W, rounds)
-            for lo in range(0, rounds, chunk_rounds):
-                hi = min(lo + chunk_rounds, rounds)
-                rngs = jnp.stack(
-                    [
-                        jax.random.fold_in(self.dropout_key, epoch * rounds + r)
-                        for r in range(lo, hi)
-                    ]
-                )
-                xb = xs_dev[lo:hi]
-                yb = ys_dev[lo:hi]
-                sched = jnp.asarray(scheds[lo:hi])
-                if hi - lo not in compiled:
-                    # AOT-compile outside the throughput accounting (lower/
-                    # compile executes nothing; steady-state numbers must not
-                    # absorb tens of seconds of XLA compilation).
-                    t0 = time.perf_counter()
-                    compiled[hi - lo] = self._run.lower(
-                        state, xb, yb, rngs, sched
-                    ).compile()
-                    dt = time.perf_counter() - t0
-                    compile_time += dt
-                    seg += dt
-                state, ps_full, _ = compiled[hi - lo](state, xb, yb, rngs, sched)
-                images += images_per_round * (hi - lo)
-                if cfg.eval_every:
-                    force(ps_full)
-                    train_time += time.perf_counter() - seg
-                    params = self._unflatten(ps_full)
-                    acc = evaluate(params, x_test, y_test)
-                    history.append((epoch, lo, acc))
-                    log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
-                    seg = time.perf_counter()
-        force(ps_full)
+        with trace(profile_dir):
+            for epoch in range(cfg.epochs):
+                scheds = async_schedule(cfg.staleness_seed + epoch, W, rounds)
+                for lo, hi in chunks:
+                    ground = epoch * rounds + lo
+                    if ground < start_round:
+                        continue  # already done by the resumed run
+                    rngs = jnp.stack(
+                        [
+                            jax.random.fold_in(self.dropout_key, epoch * rounds + r)
+                            for r in range(lo, hi)
+                        ]
+                    )
+                    sched = jnp.asarray(scheds[lo:hi])
+                    with timer.step(images=images_per_round * (hi - lo)):
+                        state, ps_full, _ = compiled[hi - lo](
+                            state, xs_dev[lo:hi], ys_dev[lo:hi], rngs, sched
+                        )
+                        force(ps_full)
+                    if cfg.eval_every:
+                        params = self._unflatten(ps_full)
+                        acc = evaluate(params, x_test, y_test)
+                        history.append((epoch, lo, acc))
+                        log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
+                    if ckpt and save_crossed(
+                        ground, hi - lo, checkpoint_every, hi == rounds
+                    ):
+                        save_checkpoint(
+                            ckpt, {"state": state},
+                            step=epoch * rounds + hi, extra={"epoch": epoch},
+                        )
         end = time.perf_counter()
-        train_time += end - seg
+        train_time = timer.total_s
+        if ps_full is None:  # fully-resumed run: nothing left to execute
+            ps_full = self._gather_ps(state)
         params = self._unflatten(ps_full)
         final_acc = evaluate(params, x_test, y_test)
         log(f"final accuracy: {final_acc}")
@@ -450,12 +500,11 @@ class AsyncTrainer:
         return TrainResult(
             params=jax.tree.map(np.asarray, params),
             final_accuracy=final_acc,
-            # Compile happens lazily inside the loop; subtract it so
-            # wall_time_s is comparable with the sync trainers (which
-            # AOT-compile before their clock starts).
-            wall_time_s=end - start - compile_time,
+            wall_time_s=end - start,
             train_time_s=train_time,
             history=history,
-            images_per_sec=images / train_time if train_time > 0 else 0.0,
+            images_per_sec=timer.total_images / train_time if train_time > 0 else 0.0,
             compile_time_s=compile_time,
+            step_stats=timer.stats(),
+            resumed_from_step=start_round,
         )

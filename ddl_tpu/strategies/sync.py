@@ -52,7 +52,17 @@ from ..parallel import collectives as coll
 from ..parallel.layout import LayoutAssignment, assign_layout
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
-from ..train.trainer import TrainResult, eval_spans, evaluate, force
+from ..train.trainer import (
+    TrainResult,
+    checkpoint_file,
+    eval_spans,
+    evaluate,
+    force,
+    save_crossed,
+    try_resume,
+)
+from ..utils.checkpoint import save_checkpoint
+from ..utils.metrics import StepTimer, trace
 
 
 @jax.tree_util.register_dataclass
@@ -395,7 +405,32 @@ class SyncTrainer:
             sharding = NamedSharding(self.mesh, P())
         return jax.device_put(xs, sharding), jax.device_put(ys, sharding)
 
-    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+    def _place_state(self, params, opt_state):
+        """Re-place host (checkpoint) state onto this trainer's shardings:
+        params replicated; Adam state replicated (DP) or m/v mesh-sharded
+        (ZeRO-1)."""
+        rep = NamedSharding(self.mesh, P())
+        params = jax.device_put(jax.tree.map(jnp.asarray, params), rep)
+        if self.layout is None:
+            opt_state = jax.device_put(jax.tree.map(jnp.asarray, opt_state), rep)
+        else:
+            shard = NamedSharding(self.mesh, P(DP_AXIS))
+            opt_state = ShardedAdam(
+                step=jax.device_put(jnp.asarray(opt_state.step), rep),
+                m=jax.device_put(jnp.asarray(opt_state.m), shard),
+                v=jax.device_put(jnp.asarray(opt_state.v), shard),
+            )
+        return params, opt_state
+
+    def train(
+        self,
+        log: Callable[[str], None] = print,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        profile_dir: str | None = None,
+    ) -> TrainResult:
         cfg = self.config
         ds = self.dataset
         batch_num = ds.num_train // cfg.batch_size
@@ -407,6 +442,12 @@ class SyncTrainer:
         # must never consume arrays the caller still owns.
         params = jax.tree.map(jnp.copy, self.params)
         opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        ckpt = checkpoint_file(checkpoint_dir)
+        tree, start_step = try_resume(
+            ckpt, resume, {"params": params, "opt": opt_state}, log
+        )
+        if tree is not None:
+            params, opt_state = self._place_state(tree["params"], tree["opt"])
         # Materialize staged data + state BEFORE the clock starts: transfers
         # are async (and lazy on the tunnel backend); steady-state throughput
         # must not absorb the host->HBM upload of the train set.
@@ -423,29 +464,35 @@ class SyncTrainer:
             for k in {k for _, k, _ in spans}
         }
         compile_time = time.perf_counter() - t0
-        images = 0
-        train_time = 0.0
+        timer = StepTimer()
         start = time.perf_counter()
-        seg = start
-        for epoch in range(cfg.epochs):
-            for first, k, eval_after in spans:
-                params, opt_state, _ = fns[k](
-                    params, opt_state, xs, ys,
-                    jnp.int32(first), jnp.int32(epoch * batch_num + first),
-                    self.dropout_key,
-                )
-                images += k * cfg.batch_size
-                if eval_after:
-                    force(params)
-                    train_time += time.perf_counter() - seg
-                    cnt = first + k - 1
-                    acc = evaluate(params, x_test, y_test)
-                    history.append((epoch, cnt, acc))
-                    log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
-                    seg = time.perf_counter()
-        force(params)
+        with trace(profile_dir):
+            for epoch in range(cfg.epochs):
+                for first, k, eval_after in spans:
+                    gstep = epoch * batch_num + first
+                    if gstep < start_step:
+                        continue  # already done by the resumed run
+                    with timer.step(images=k * cfg.batch_size):
+                        params, opt_state, _ = fns[k](
+                            params, opt_state, xs, ys,
+                            jnp.int32(first), jnp.int32(gstep),
+                            self.dropout_key,
+                        )
+                        force(params)
+                    if eval_after:
+                        cnt = first + k - 1
+                        acc = evaluate(params, x_test, y_test)
+                        history.append((epoch, cnt, acc))
+                        log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                    if ckpt and save_crossed(
+                        gstep, k, checkpoint_every, first + k == batch_num
+                    ):
+                        save_checkpoint(
+                            ckpt, {"params": params, "opt": opt_state},
+                            step=gstep + k, extra={"epoch": epoch},
+                        )
         end = time.perf_counter()
-        train_time += end - seg
+        train_time = timer.total_s
         final_acc = evaluate(params, x_test, y_test)
         log(f"final accuracy: {final_acc}")
         self.params, self.opt_state = params, opt_state
@@ -455,6 +502,8 @@ class SyncTrainer:
             wall_time_s=end - start,
             train_time_s=train_time,
             history=history,
-            images_per_sec=images / train_time if train_time > 0 else 0.0,
+            images_per_sec=timer.total_images / train_time if train_time > 0 else 0.0,
             compile_time_s=compile_time,
+            step_stats=timer.stats(),
+            resumed_from_step=start_step,
         )

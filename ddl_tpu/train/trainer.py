@@ -14,6 +14,7 @@ numerical oracle the distributed strategies are tested against.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable
 
@@ -24,6 +25,8 @@ import numpy as np
 from ..data import Dataset, one_hot
 from ..models import cnn
 from ..ops import AdamState, adam_init, adam_update
+from ..utils.checkpoint import load_checkpoint, save_checkpoint
+from ..utils.metrics import StepStats, StepTimer, trace
 from .config import TrainConfig
 
 
@@ -36,6 +39,8 @@ class TrainResult:
     history: list[tuple[int, int, float]]  # (epoch, batch, accuracy)
     images_per_sec: float  # images / train_time_s
     compile_time_s: float = 0.0  # AOT compilation of the epoch programs
+    step_stats: StepStats | None = None  # per-span dispatch-time percentiles
+    resumed_from_step: int = 0  # global step restored from a checkpoint (0 = fresh)
 
 
 def make_train_step(
@@ -144,6 +149,51 @@ def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
     return jax.jit(chunk, donate_argnums=(0, 1))
 
 
+def checkpoint_file(checkpoint_dir: str | os.PathLike | None) -> str | None:
+    """The rolling checkpoint path inside ``checkpoint_dir`` (atomic
+    ``os.replace`` makes one rolling file crash-safe — see
+    ddl_tpu.utils.checkpoint)."""
+    if checkpoint_dir is None:
+        return None
+    return os.path.join(os.fspath(checkpoint_dir), "ckpt.npz")
+
+
+def try_resume(
+    ckpt_path: str | None,
+    resume: bool,
+    like,
+    log: Callable[[str], None],
+):
+    """Load the rolling checkpoint if resuming. Returns ``(tree|None, step)``
+    where ``step`` is the global step count already completed (0 = fresh).
+
+    A missing file starts fresh (first run of a to-be-resumed job); the
+    caller re-places arrays onto its shardings. The reference cannot resume
+    at all — params die with the TF session (mnist_sync/model/model.py:109-112).
+    """
+    if not resume:
+        return None, 0
+    if ckpt_path is None:
+        raise ValueError("resume requires a checkpoint directory")
+    if not os.path.exists(ckpt_path):
+        log(f"[resume] no checkpoint at {ckpt_path}; starting fresh")
+        return None, 0
+    tree, step, _extra = load_checkpoint(ckpt_path, like)
+    step = int(step or 0)
+    log(f"[resume] restored global step {step} from {ckpt_path}")
+    return tree, step
+
+
+def save_crossed(gstep: int, k: int, every: int, epoch_end: bool) -> bool:
+    """Checkpoint cadence: save at every epoch end, plus whenever the span
+    ``[gstep, gstep+k)`` crosses a multiple of ``every`` (0 = epoch-end
+    only). Spans are the save boundaries — state between span boundaries
+    never exists on the host."""
+    if epoch_end:
+        return True
+    return bool(every) and (gstep + k) // every > gstep // every
+
+
 # Module-level so the jit cache is shared across evaluate() calls.
 _jit_accuracy = jax.jit(cnn.accuracy)
 
@@ -188,7 +238,15 @@ class SingleChipTrainer:
             self._chunks[k] = make_epoch_chunk(self.config, k)
         return self._chunks[k]
 
-    def train(self, log: Callable[[str], None] = print) -> TrainResult:
+    def train(
+        self,
+        log: Callable[[str], None] = print,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        profile_dir: str | None = None,
+    ) -> TrainResult:
         cfg = self.config
         batch_num = self.dataset.num_train // cfg.batch_size
         n = batch_num * cfg.batch_size
@@ -213,6 +271,13 @@ class SingleChipTrainer:
         # never consume arrays the caller still owns (e.g. a shared init).
         params = jax.tree.map(jnp.copy, self.params)
         opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        ckpt = checkpoint_file(checkpoint_dir)
+        tree, start_step = try_resume(
+            ckpt, resume, {"params": params, "opt": opt_state}, log
+        )
+        if tree is not None:
+            params = jax.tree.map(jnp.asarray, tree["params"])
+            opt_state = jax.tree.map(jnp.asarray, tree["opt"])
         # Materialize staged data + state BEFORE the clock starts: transfers
         # are async (and lazy on the tunnel backend); steady-state throughput
         # must not absorb the host->HBM upload of the train set.
@@ -229,39 +294,46 @@ class SingleChipTrainer:
             for k in {k for _, k, _ in spans}
         }
         compile_time = time.perf_counter() - t0
-        images = 0
-        train_time = 0.0
+        timer = StepTimer()
         start = time.perf_counter()
-        segment_start = start
-        for epoch in range(cfg.epochs):
-            for first, k, eval_after in spans:
-                params, opt_state, _ = fns[k](
-                    params, opt_state, xs, ys,
-                    jnp.int32(first), jnp.int32(epoch * batch_num + first),
-                    self.dropout_key,
-                )
-                images += k * cfg.batch_size
-                if eval_after:
-                    force(params)
-                    train_time += time.perf_counter() - segment_start
-                    cnt = first + k - 1
-                    acc = evaluate(params, x_test, y_test)
-                    history.append((epoch, cnt, acc))
-                    log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
-                    segment_start = time.perf_counter()
-        force(params)
+        with trace(profile_dir):
+            for epoch in range(cfg.epochs):
+                for first, k, eval_after in spans:
+                    gstep = epoch * batch_num + first
+                    if gstep < start_step:
+                        continue  # already done by the resumed run
+                    with timer.step(images=k * cfg.batch_size):
+                        params, opt_state, _ = fns[k](
+                            params, opt_state, xs, ys,
+                            jnp.int32(first), jnp.int32(gstep),
+                            self.dropout_key,
+                        )
+                        force(params)
+                    if eval_after:
+                        cnt = first + k - 1
+                        acc = evaluate(params, x_test, y_test)
+                        history.append((epoch, cnt, acc))
+                        log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                    if ckpt and save_crossed(
+                        gstep, k, checkpoint_every, first + k == batch_num
+                    ):
+                        save_checkpoint(
+                            ckpt, {"params": params, "opt": opt_state},
+                            step=gstep + k, extra={"epoch": epoch},
+                        )
         end = time.perf_counter()
-        train_time += end - segment_start
-        wall = end - start
+        train_time = timer.total_s
         final_acc = evaluate(params, x_test, y_test)
         log(f"final accuracy: {final_acc}")
         self.params, self.opt_state = params, opt_state
         return TrainResult(
             params=jax.tree.map(np.asarray, params),
             final_accuracy=final_acc,
-            wall_time_s=wall,
+            wall_time_s=end - start,
             train_time_s=train_time,
             history=history,
-            images_per_sec=images / train_time if train_time > 0 else 0.0,
+            images_per_sec=timer.total_images / train_time if train_time > 0 else 0.0,
             compile_time_s=compile_time,
+            step_stats=timer.stats(),
+            resumed_from_step=start_step,
         )

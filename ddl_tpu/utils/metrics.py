@@ -37,33 +37,46 @@ class StepStats:
 class StepTimer:
     """Per-step wall-clock timer with warmup exclusion.
 
-    Usage::
+    A "step" is one timed dispatch unit — a single train step, or a whole
+    device-resident span (the trainers time each compiled span program as
+    one step and pass its image count). Usage::
 
         timer = StepTimer(batch_size=100, warmup=2)
         for ...:
-            with timer.step():
+            with timer.step():                # or timer.step(images=k*bs)
                 params, opt, _ = train_step(...)
         print(timer.stats().line())
 
-    Timing includes dispatch but the caller should block on the result
-    inside the ``step()`` context for accurate numbers (or rely on jit's
-    implicit data dependence on the previous step's output, the steady-state
-    pattern used by ``bench.py``).
+    The caller must close each ``step()`` context with a true barrier
+    (``train.trainer.force``) for accurate numbers — dispatch alone returns
+    immediately.
     """
 
-    def __init__(self, batch_size: int, warmup: int = 2):
+    def __init__(self, batch_size: int | None = None, warmup: int = 0):
         self.batch_size = batch_size
         self.warmup = warmup
         self._times: list[float] = []
+        self._images: list[int] = []
 
     @contextlib.contextmanager
-    def step(self):
+    def step(self, images: int | None = None):
         t0 = time.perf_counter()
         yield
         self._times.append(time.perf_counter() - t0)
+        self._images.append(images if images is not None else (self.batch_size or 0))
+
+    @property
+    def total_s(self) -> float:
+        """Total timed seconds, warmup included (throughput accounting)."""
+        return float(sum(self._times))
+
+    @property
+    def total_images(self) -> int:
+        return int(sum(self._images))
 
     def stats(self) -> StepStats:
         times = np.asarray(self._times[self.warmup :])
+        images = np.asarray(self._images[self.warmup :])
         if times.size == 0:
             return StepStats(0, 0.0, 0.0, 0.0, 0.0, 0.0)
         total = float(times.sum())
@@ -73,7 +86,7 @@ class StepTimer:
             p50_ms=float(np.percentile(times, 50) * 1e3),
             p95_ms=float(np.percentile(times, 95) * 1e3),
             total_s=total,
-            images_per_sec=times.size * self.batch_size / total,
+            images_per_sec=float(images.sum()) / total if total else 0.0,
         )
 
 

@@ -1,0 +1,157 @@
+"""Device-resident sync path tests: ``make_sync_epoch`` parity with the
+per-step programs it chunks, and ``SyncTrainer`` end-to-end against the
+single-chip oracle (the reference loop it replaces:
+mnist_sync/worker.py:60-72).
+
+All on the 8-device virtual CPU mesh with the narrow model family
+(conftest.SMALL_SPECS).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl_tpu.data import one_hot
+from ddl_tpu.models import cnn
+from ddl_tpu.ops import adam_init
+from ddl_tpu.parallel.mesh import DP_AXIS, make_mesh
+from ddl_tpu.strategies.sync import (
+    SyncTrainer,
+    make_dp_step,
+    make_sharded_step,
+    make_sync_epoch,
+    resolve_layout,
+    sharded_adam_init,
+)
+from ddl_tpu.train import SingleChipTrainer, TrainConfig
+
+W = 8
+GB = 32  # global batch
+B = 4  # batches in the staged epoch
+
+
+def _sizes(params):
+    return {k: int(np.prod(v.shape)) if v.shape else 1 for k, v in params.items()}
+
+
+@pytest.fixture(scope="module")
+def epoch_batches(small_dataset):
+    """B global batches [B, GB, ...] in reference order."""
+    n = B * GB
+    x = np.asarray(small_dataset.x_train[:n]).reshape(B, GB, -1)
+    y = one_hot(small_dataset.y_train[:n]).reshape(B, GB, -1)
+    return x, y
+
+
+def _staged(mesh, x, y):
+    """Trainer staging layout: [W, B, GB/W, ...] with worker w's slice of
+    every batch on device w (mirrors SyncTrainer._stage_epoch)."""
+    pb = GB // W
+    xs = np.ascontiguousarray(
+        x.reshape(B, W, pb, x.shape[-1]).transpose(1, 0, 2, 3)
+    )
+    ys = np.ascontiguousarray(
+        y.reshape(B, W, pb, y.shape[-1]).transpose(1, 0, 2, 3)
+    )
+    sh = NamedSharding(mesh, P(DP_AXIS))
+    return jax.device_put(xs, sh), jax.device_put(ys, sh)
+
+
+def _max_abs_diff(a, b):
+    return max(
+        jax.tree.leaves(
+            jax.tree.map(lambda u, v: float(jnp.max(jnp.abs(u - v))), a, b)
+        )
+    )
+
+
+@pytest.mark.parametrize("variant", ["dp", "sharded"])
+def test_sync_epoch_matches_per_step_path(
+    small_params, epoch_batches, variant
+):
+    """The docstring claim at make_sync_epoch: span chunking feeds the same
+    dropout stream as the per-step path, so k scanned steps reproduce k
+    sequential step() calls. Dropout ON to pin the rng plumbing; span
+    offset (first=1, goff=7) exercised so resume/eval chunking is covered."""
+    mesh = make_mesh(W)
+    x, y = epoch_batches
+    cfg = TrainConfig(
+        num_workers=W, num_ps=4 if variant == "sharded" else 1,
+        layout="zigzag", batch_size=GB, keep_prob=0.5, seed=0,
+    )
+    shapes = cnn.param_shapes(small_params)
+    layout = resolve_layout(cfg, W, _sizes(small_params))
+    if variant == "dp":
+        assert layout is None
+        step = make_dp_step(cfg, mesh)
+        opt0 = jax.device_put(
+            adam_init(small_params), NamedSharding(mesh, P())
+        )
+    else:
+        step = make_sharded_step(cfg, mesh, layout, shapes)
+        opt0 = sharded_adam_init(mesh, layout)
+    params0 = jax.device_put(small_params, NamedSharding(mesh, P()))
+    rng_base = jax.random.PRNGKey(11)
+    first, k, goff = 1, 3, 7
+
+    # Per-step oracle: k sequential calls on the batch-sharded stream.
+    data_sh = NamedSharding(mesh, P(DP_AXIS))
+    p_ref, o_ref = params0, opt0
+    for j in range(k):
+        xb = jax.device_put(jnp.asarray(x[first + j]), data_sh)
+        yb = jax.device_put(jnp.asarray(y[first + j]), data_sh)
+        p_ref, o_ref, _ = step(
+            p_ref, o_ref, xb, yb, jax.random.fold_in(rng_base, goff + j)
+        )
+
+    # Device-resident span: one compiled program.
+    xs, ys = _staged(mesh, x, y)
+    run = make_sync_epoch(cfg, mesh, layout, shapes, k)
+    p_span, o_span, _ = run(
+        params0, opt0, xs, ys, jnp.int32(first), jnp.int32(goff), rng_base
+    )
+    assert _max_abs_diff(p_ref, p_span) == 0.0
+    if variant == "sharded":
+        np.testing.assert_array_equal(np.asarray(o_ref.m), np.asarray(o_span.m))
+        np.testing.assert_array_equal(np.asarray(o_ref.v), np.asarray(o_span.v))
+
+
+@pytest.mark.parametrize("num_ps,layout", [(1, "block"), (4, "lpt")])
+def test_sync_trainer_matches_single_chip(
+    small_dataset, small_params, num_ps, layout
+):
+    """SyncTrainer over the 8-device mesh ≡ SingleChipTrainer on the same
+    global batch stream (keep_prob=1 ⇒ no dropout divergence; mean
+    reduction over equal shards ≡ full-batch gradient)."""
+    cfg_s = TrainConfig(epochs=2, batch_size=256, eval_every=3,
+                        keep_prob=1.0, seed=1)
+    single = SingleChipTrainer(cfg_s, small_dataset, init=small_params).train(
+        log=lambda s: None
+    )
+    cfg_m = TrainConfig(epochs=2, batch_size=256, eval_every=3,
+                        keep_prob=1.0, seed=1, num_workers=W,
+                        num_ps=num_ps, layout=layout)
+    multi = SyncTrainer(cfg_m, small_dataset, init=small_params).train(
+        log=lambda s: None
+    )
+    assert _max_abs_diff(single.params, multi.params) < 2e-5
+    # Same eval cadence as the reference (worker.py:71-72).
+    assert [(e, b) for e, b, _ in multi.history] == [
+        (e, b) for e, b, _ in single.history
+    ]
+
+
+def test_sync_trainer_repeated_train_is_safe(small_dataset, small_params):
+    """The span programs donate params/opt on TPU; train() must copy first
+    so the trainer (and the shared init tree) survives repeated calls
+    (mirror of test_single_trainer.py donation test)."""
+    cfg = TrainConfig(epochs=1, batch_size=512, eval_every=0, seed=2,
+                      num_workers=W, num_ps=W, layout="flat")
+    trainer = SyncTrainer(cfg, small_dataset, init=small_params)
+    trainer.train(log=lambda s: None)
+    # Second call continues from the updated trainer state; it would raise
+    # on donated/deleted buffers if train() skipped the defensive copies.
+    trainer.train(log=lambda s: None)
+    np.asarray(small_params["v0"])  # shared init still alive
