@@ -251,8 +251,11 @@ def make_sync_epoch(
     - replicated data (``shard_data=False`` compat): ``[B, bs, ...]``, ``P()``.
 
     ``first`` is the span's first batch index and ``goff`` the global step
-    offset feeding the dropout stream (identical streams to the per-step
-    path, so device-resident training is bit-compatible with it).
+    offset feeding the dropout stream — identical streams to the per-step
+    path, so span chunking never changes the math. The scanned program and
+    the per-step programs are compiled separately, so XLA fusion may
+    reassociate float ops: outputs agree to ~1e-7, not bitwise
+    (pinned by tests/test_sync_trainer.py).
     """
     W = mesh.devices.size
     if layout is None:

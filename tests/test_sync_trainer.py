@@ -73,8 +73,11 @@ def test_sync_epoch_matches_per_step_path(
 ):
     """The docstring claim at make_sync_epoch: span chunking feeds the same
     dropout stream as the per-step path, so k scanned steps reproduce k
-    sequential step() calls. Dropout ON to pin the rng plumbing; span
-    offset (first=1, goff=7) exercised so resume/eval chunking is covered."""
+    sequential step() calls — up to XLA fusion reassociation between the
+    two separately-compiled programs (~1 ulp; exact equality is not
+    guaranteed across compilations). Dropout ON to pin the rng plumbing;
+    span offset (first=1, goff=7) exercised so resume/eval chunking is
+    covered."""
     mesh = make_mesh(W)
     x, y = epoch_batches
     cfg = TrainConfig(
@@ -112,10 +115,14 @@ def test_sync_epoch_matches_per_step_path(
     p_span, o_span, _ = run(
         params0, opt0, xs, ys, jnp.int32(first), jnp.int32(goff), rng_base
     )
-    assert _max_abs_diff(p_ref, p_span) == 0.0
+    assert _max_abs_diff(p_ref, p_span) < 1e-7
     if variant == "sharded":
-        np.testing.assert_array_equal(np.asarray(o_ref.m), np.asarray(o_span.m))
-        np.testing.assert_array_equal(np.asarray(o_ref.v), np.asarray(o_span.v))
+        np.testing.assert_allclose(
+            np.asarray(o_ref.m), np.asarray(o_span.m), atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(o_ref.v), np.asarray(o_span.v), atol=1e-7
+        )
 
 
 @pytest.mark.parametrize("num_ps,layout", [(1, "block"), (4, "lpt")])
