@@ -364,8 +364,15 @@ class AsyncTrainer:
         bs = cfg.batch_size
         x = np.asarray(ds.x_train)
         y = one_hot(ds.y_train)
+        need = bs * W if cfg.shard_data else bs  # examples per round
+        rounds = ds.num_train // need
+        if rounds < 1:
+            raise ValueError(
+                f"dataset too small for async training: {ds.num_train} train "
+                f"examples < one round ({need} = batch_size"
+                f"{' * num_workers' if cfg.shard_data else ''})"
+            )
         if cfg.shard_data:
-            rounds = ds.num_train // (bs * W)
             n = rounds * bs * W
             # Worker w gets the w-th contiguous 1/W slice of the train set.
             xs = x[:n].reshape(W, rounds, bs, -1).transpose(1, 0, 2, 3)
@@ -373,17 +380,9 @@ class AsyncTrainer:
         else:
             # Reference stream: every worker trains on the same batches —
             # stored once, replicated by the data sharding ([R, bs, ...]).
-            rounds = ds.num_train // bs
             n = rounds * bs
             xs = x[:n].reshape(rounds, bs, -1)
             ys = y[:n].reshape(rounds, bs, -1)
-        if rounds < 1:
-            need = bs * W if cfg.shard_data else bs
-            raise ValueError(
-                f"dataset too small for async training: {ds.num_train} train "
-                f"examples < one round ({need} = batch_size"
-                f"{' * num_workers' if cfg.shard_data else ''})"
-            )
         return np.ascontiguousarray(xs), np.ascontiguousarray(ys), rounds
 
     def _gather_ps(self, state: AsyncState) -> jax.Array:
