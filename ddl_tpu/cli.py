@@ -155,8 +155,10 @@ def config_from_args(args) -> "TrainConfig":
     conv_channels = args.conv_channels
     fc_sizes = args.fc_sizes
     if args.tiny:
-        conv_channels = conv_channels or (4, 8, 8, 8)
-        fc_sizes = fc_sizes or (32, 16)
+        from .models.cnn import TINY_CONV_CHANNELS, TINY_FC_SIZES
+
+        conv_channels = conv_channels or TINY_CONV_CHANNELS
+        fc_sizes = fc_sizes or TINY_FC_SIZES
     if conv_channels is not None and (
         len(conv_channels) != 4 or min(conv_channels) < 1
     ):

@@ -66,6 +66,12 @@ def make_param_specs(
 # The reference model (SURVEY.md §2.1: 2,656,010 params).
 PARAM_SPECS: Specs = make_param_specs()
 
+# Narrow-width instance of the same 14-variable family (~1/400 the FLOPs):
+# the CLI --tiny preset, the test suite's SMALL_SPECS, and the driver dryrun
+# all train this exact model.
+TINY_CONV_CHANNELS: tuple[int, int, int, int] = (4, 8, 8, 8)
+TINY_FC_SIZES: tuple[int, int] = (32, 16)
+
 PARAM_NAMES: tuple[str, ...] = tuple(name for name, _ in PARAM_SPECS)
 
 Params = Mapping[str, jax.Array]

@@ -32,7 +32,10 @@ from ddl_tpu.models import cnn  # noqa: E402
 # structure (14 vars, 4 conv+pool stages, 2 dropout FCs) at ~1/400 the
 # FLOPs, so multi-device integration tests fit a single-core CPU host.
 # Full-width parity with the torch oracle is covered in test_model.py.
-SMALL_SPECS = cnn.make_param_specs(conv_channels=(4, 8, 8, 8), fc_sizes=(32, 16))
+# Same widths as the CLI --tiny preset and the driver dryrun.
+SMALL_SPECS = cnn.make_param_specs(
+    conv_channels=cnn.TINY_CONV_CHANNELS, fc_sizes=cnn.TINY_FC_SIZES
+)
 
 
 @pytest.fixture(scope="session")

@@ -1,7 +1,15 @@
-"""CLI argument → config mapping (the run.sh replacement, SURVEY.md §1
-launcher layer). Pure parsing — no training, no device use."""
+"""CLI layer (the run.sh replacement, SURVEY.md §1 launcher layer):
+argument → config mapping, plus end-to-end drives of ``main()`` — every
+variant trains a tiny run to completion through the real entry point on
+the virtual 8-device mesh."""
 
-from ddl_tpu.cli import build_parser, config_from_args
+import json
+import subprocess
+import sys
+
+import pytest
+
+from ddl_tpu.cli import build_parser, config_from_args, main
 
 
 def _cfg(argv):
@@ -65,7 +73,75 @@ def test_default_batch_rounds_to_worker_multiple():
 
 
 def test_explicit_indivisible_batch_fails_fast():
-    import pytest
-
     with pytest.raises(SystemExit, match="not divisible"):
         _cfg(["sync", "--num-workers", "8", "--batch-size", "100"])
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: main() trains every variant on the 8-device mesh (VERDICT r2
+# task 7). --tiny narrow model + small procedural data keep each run to a
+# few seconds; the JSON line is the machine-readable contract.
+
+_E2E = [
+    "--tiny", "--batch-size", "16", "--synthetic-train", "512",
+    "--synthetic-test", "64", "--eval-every", "4", "--json",
+]
+
+
+def _run_main(argv, capsys, *, expect_steps=True):
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert 0.0 <= payload["final_accuracy"] <= 1.0
+    if expect_steps:
+        assert payload["step_stats"]["steps"] > 0
+        assert payload["images_per_sec"] > 0
+    return payload
+
+
+@pytest.mark.parametrize("variant", [
+    "single", "sync", "async", "sync_sharding", "async_sharding",
+    "sync_sharding_greedy", "async_sharding_greedy",
+])
+def test_main_end_to_end(variant, capsys):
+    argv = [variant] + _E2E
+    if variant != "single":
+        argv += ["--num-workers", "8"]
+    if "sharding" in variant:
+        argv += ["--num-ps", "4"]
+    payload = _run_main(argv, capsys)
+    assert payload["variant"] == variant
+    assert payload["config"]["conv_channels"] == [4, 8, 8, 8]
+
+
+def test_main_reference_compat_end_to_end(capsys):
+    payload = _run_main(
+        ["sync", "--num-workers", "8", "--reference-compat"] + _E2E, capsys
+    )
+    assert payload["config"]["grad_reduction"] == "sum"
+    assert payload["config"]["shard_data"] is False
+
+
+def test_main_checkpoint_resume_roundtrip(tmp_path, capsys):
+    d = str(tmp_path / "ckpt")
+    args = ["sync_sharding", "--num-workers", "8", "--num-ps", "8",
+            "--layout", "flat", "--checkpoint-dir", d] + _E2E
+    _run_main(args, capsys)
+    # All 32 batches were done by run 1, so the resumed run replays nothing
+    # (zero spans dispatched — expect_steps off).
+    resumed = _run_main(args + ["--resume"], capsys, expect_steps=False)
+    assert resumed["resumed_from_step"] == 32
+
+
+def test_cli_subprocess_smoke():
+    """The real process path: python -m ddl_tpu with an explicit --platform
+    (the tunnel sitecustomize override) in a fresh interpreter."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ddl_tpu", "sync_sharding_greedy",
+         "--platform", "cpu", "--num-workers", "8", "--num-ps", "4"] + _E2E,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["variant"] == "sync_sharding_greedy"
+    assert payload["config"]["layout"] == "zigzag"
