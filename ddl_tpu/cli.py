@@ -109,6 +109,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "tunnel's sitecustomize overrides JAX_PLATFORMS, so "
                         "an env var cannot; '--platform cpu' gives a "
                         "hermetic virtual mesh for CI and smoke runs)")
+    p.add_argument("--multihost", action="store_true",
+                   help="join a multi-process JAX world before training "
+                        "(jax.distributed over DCN — the mpiexec-MPMD "
+                        "equivalent, reference run.sh:3). Run the same "
+                        "command on every host with --process-id set; on a "
+                        "TPU pod slice the coordinator/process args can all "
+                        "be omitted (inferred from the TPU environment)")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multihost coordinator address (process 0's host; "
+                        "default: self-hosted when --num-processes 1, "
+                        "TPU-environment-inferred otherwise)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="multihost world size (default: inferred)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank in the multihost world "
+                        "(default: inferred)")
     return p
 
 
@@ -234,6 +250,18 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
             jax.config.update("jax_num_cpu_devices", max(args.num_workers or 8, 8))
+    if args.multihost:
+        # Before any backend use: joining the world after the local backend
+        # initializes would freeze a single-process device view.
+        import jax
+
+        from .parallel import multihost
+
+        multihost.initialize(
+            args.coordinator, args.num_processes, args.process_id
+        )
+        print(f"[ddl_tpu] multihost: process {jax.process_index()}/"
+              f"{jax.process_count()}, {len(jax.devices())} global devices")
     from .data import load_mnist
 
     dataset = load_mnist(

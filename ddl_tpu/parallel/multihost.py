@@ -1,0 +1,172 @@
+"""Multi-host launch: ``jax.distributed`` over DCN.
+
+The reference spans OS processes (and potentially nodes) with ``mpiexec``
+MPMD — PS ranks then worker ranks in one MPI world
+(reference: mnist_sync/run.sh:3; rank conventions at
+mnist_sync_sharding/worker.py:60-66). The TPU-native equivalent is JAX's
+multi-controller runtime (SURVEY.md §5 "distributed communication
+backend"): every process runs the SAME SPMD program over its local chips,
+``jax.distributed.initialize`` wires the processes into one global device
+world over DCN, and arrays sharded over the global mesh make XLA place
+collectives on ICI within a host and DCN across hosts. There are no
+PS/worker *processes* — the role split stays a sharding, exactly as on one
+host.
+
+What changes per process is only the DATA: each process feeds the mesh rows
+its local devices own (:func:`local_worker_rows`) and builds global arrays
+with :func:`put` (``jax.make_array_from_process_local_data``). At
+``process_count() == 1`` every helper degenerates to plain ``device_put``,
+so the single-host path is byte-identical to not using this module — the
+product trainers route all placement through :func:`put` unconditionally.
+
+Launch (one process per host, same command everywhere):
+
+    python -m ddl_tpu sync --multihost \\
+        --coordinator host0:8476 --num-processes 2 --process-id $RANK
+
+On a real TPU pod slice, ``initialize()`` with no arguments lets JAX pick
+everything up from the TPU metadata environment.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Sequence
+
+import numpy as np
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (single-process coordinator default)."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> None:
+    """Join (or form) the multi-process JAX world.
+
+    Must run before any other JAX call in the process (backend init
+    freezes the device world). With all arguments ``None`` on a TPU pod
+    slice, JAX infers everything from the TPU environment — that inference
+    must NOT be pre-empted here, or every pod host would silently form its
+    own 1-process world. Only the explicit ``num_processes=1`` degenerate
+    case (the testable-on-one-host path) self-hosts a coordinator on a
+    free local port.
+    """
+    import jax
+
+    if num_processes == 1 and coordinator_address is None:
+        coordinator_address = f"localhost:{free_port()}"
+        process_id = 0
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def shutdown() -> None:
+    import jax
+
+    jax.distributed.shutdown()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def local_worker_rows(mesh) -> np.ndarray:
+    """Mesh-axis positions whose device is addressable by THIS process —
+    the worker rows this process must feed (the analogue of each reference
+    worker slicing its own batches, mnist_sync/worker.py:27-30)."""
+    import jax
+
+    pid = jax.process_index()
+    return np.asarray(
+        [i for i, d in enumerate(mesh.devices.flat) if d.process_index == pid],
+        dtype=np.int64,
+    )
+
+
+def sharded_dim(pspec, axis_name: str) -> int | None:
+    """The array dimension ``pspec`` shards along ``axis_name`` (None when
+    replicated). 1-D meshes: the axis appears at most once."""
+    for i, entry in enumerate(tuple(pspec)):
+        if entry == axis_name or (
+            isinstance(entry, tuple) and axis_name in entry
+        ):
+            return i
+    return None
+
+
+def local_slice(host_array, dim: int, num_shards: int, rows) -> np.ndarray:
+    """The blocks of ``host_array`` along ``dim`` owned by mesh positions
+    ``rows`` when that dim splits into ``num_shards`` equal blocks — the
+    per-process data-feeding math, pure so it is unit-testable without a
+    second process."""
+    per = host_array.shape[dim] // num_shards
+    idx = np.concatenate([np.arange(r * per, (r + 1) * per) for r in rows])
+    return np.take(np.asarray(host_array), idx, axis=dim)
+
+
+def put(mesh, pspec, host_array) -> Any:
+    """Place a host array onto the global mesh with
+    ``NamedSharding(mesh, pspec)``.
+
+    Single process: plain ``device_put`` (the fast, familiar path).
+    Multi-process: every process passes the FULL logical array (datasets
+    here are deterministic, so each host materializes the same array);
+    the blocks its devices own are extracted per the sharded axis and
+    handed to ``jax.make_array_from_process_local_data``, which assembles
+    the global ``jax.Array`` without any cross-host transfer.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, pspec)
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    dim = sharded_dim(pspec, mesh.axis_names[0])
+    local = np.asarray(host_array)
+    if dim is not None:
+        local = local_slice(local, dim, mesh.devices.size,
+                            local_worker_rows(mesh))
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def replicate_for_host(mesh, tree) -> Any:
+    """Make every leaf fully replicated — and therefore addressable from
+    every process — before materializing to numpy (checkpoint saves, final
+    param gathers). At ``process_count() == 1`` this is a no-op; in a
+    multi-process world it is one cross-host reshard collective per leaf
+    (``device_put`` to a replicated NamedSharding)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if jax.process_count() == 1:
+        return tree
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.tree.map(lambda a: jax.device_put(a, rep), tree)
+
+
+def put_tree(mesh, pspec_tree, host_tree) -> Any:
+    """``put`` over a pytree: ``pspec_tree`` is either one PartitionSpec
+    applied to every leaf or a matching tree of specs."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    if isinstance(pspec_tree, PartitionSpec):
+        return jax.tree.map(lambda a: put(mesh, pspec_tree, a), host_tree)
+    return jax.tree.map(
+        lambda spec, a: put(mesh, spec, a), pspec_tree, host_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )

@@ -49,11 +49,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..data import Dataset, one_hot
 from ..models import cnn
 from ..parallel import collectives as coll
+from ..parallel import multihost
 from ..parallel.layout import LayoutAssignment
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
@@ -283,15 +284,14 @@ def async_state_init(
     W = mesh.devices.size
     spec = _flat_spec(layout, cnn.param_shapes(params))
     flat = np.asarray(coll.flatten_params(jax.tree.map(jnp.asarray, params), spec))
-    t = jnp.zeros((), jnp.int32)
+    t = np.zeros((), np.int32)
     if layout is None:
-        rep = NamedSharding(mesh, P())
-        ps = jax.device_put(jnp.asarray(flat), rep)
-        workers = jax.device_put(jnp.tile(flat, (W, 1)), rep)
-        zeros = jax.device_put(jnp.zeros_like(ps), rep)
+        ps = multihost.put(mesh, P(), flat)
+        workers = multihost.put(mesh, P(), np.tile(flat, (W, 1)))
+        zeros = multihost.put(mesh, P(), np.zeros_like(flat))
         return AsyncState(
             ps=ps, m=zeros, v=jnp.copy(zeros), workers=workers,
-            t=jax.device_put(t, rep),
+            t=multihost.put(mesh, P(), t),
         )
     chunk = layout.max_shard
     pad_len = max(W * chunk, layout.total + chunk)
@@ -305,13 +305,14 @@ def async_state_init(
         starts[:, None] + np.arange(chunk, dtype=np.int32)[None, :], pad_len - 1
     )
     ps_chunks = padded[slice_idx].reshape(-1)  # [W * chunk], owner-major
-    shard = NamedSharding(mesh, P(DP_AXIS))
-    ps = jax.device_put(jnp.asarray(ps_chunks), shard)
-    zeros = jax.device_put(jnp.zeros_like(ps), shard)
-    workers = jax.device_put(jnp.tile(flat, (W, 1)), shard)  # row w on device w
+    ps = multihost.put(mesh, P(DP_AXIS), ps_chunks)
+    zeros = multihost.put(mesh, P(DP_AXIS), np.zeros_like(ps_chunks))
+    workers = multihost.put(  # row w on device w
+        mesh, P(DP_AXIS), np.tile(flat, (W, 1))
+    )
     return AsyncState(
         ps=ps, m=zeros, v=jnp.copy(zeros), workers=workers,
-        t=jax.device_put(t, NamedSharding(mesh, P())),
+        t=multihost.put(mesh, P(), t),
     )
 
 
@@ -390,17 +391,18 @@ class AsyncTrainer:
         chunks reassembled to flat (layout) order when sharded."""
         if self.layout is None:
             return state.ps
-        flat = np.asarray(state.ps)  # host gather of [W * chunk]
+        # Host gather of [W * chunk]; replicate first so the shards are
+        # addressable from every process (no-op at one process).
+        flat = np.asarray(multihost.replicate_for_host(self.mesh, state.ps))
         return jnp.asarray(flat[coll.reassembly_index(self.layout)])
 
     def _place_state(self, state: AsyncState) -> AsyncState:
         """Re-place host (checkpoint) state onto this trainer's shardings."""
-        rep = NamedSharding(self.mesh, P())
-        sh = rep if self.layout is None else NamedSharding(self.mesh, P(DP_AXIS))
-        put = lambda a, s: jax.device_put(jnp.asarray(a), s)
+        sh = P() if self.layout is None else P(DP_AXIS)
+        put = lambda a, s: multihost.put(self.mesh, s, np.asarray(a))
         return AsyncState(
             ps=put(state.ps, sh), m=put(state.m, sh), v=put(state.v, sh),
-            workers=put(state.workers, sh), t=put(state.t, rep),
+            workers=put(state.workers, sh), t=put(state.t, P()),
         )
 
     def train(
@@ -415,11 +417,11 @@ class AsyncTrainer:
         cfg = self.config
         W = cfg.num_workers
         xs_all, ys_all, rounds = self._batches()
-        x_test = jnp.asarray(self.dataset.x_test)
-        y_test = jnp.asarray(one_hot(self.dataset.y_test))
-        data_sharding = NamedSharding(
-            self.mesh, P(None, DP_AXIS) if cfg.shard_data else P()
-        )
+        # Replicated placement (multi-process: a host-local jnp.asarray would
+        # be device-incompatible with the global params at the first eval).
+        x_test = multihost.put(self.mesh, P(), np.asarray(self.dataset.x_test))
+        y_test = multihost.put(self.mesh, P(), one_hot(self.dataset.y_test))
+        data_spec = P(None, DP_AXIS) if cfg.shard_data else P()
 
         # Fresh buffers: the round program donates the state (on TPU), which
         # must never consume arrays the caller still owns.
@@ -431,8 +433,8 @@ class AsyncTrainer:
         # Stage the full epoch on the mesh once, BEFORE the clock starts
         # (transfers are async/lazy; slicing device-resident rounds is free
         # and keeps the sharding).
-        xs_dev = jax.device_put(xs_all, data_sharding)
-        ys_dev = jax.device_put(ys_all, data_sharding)
+        xs_dev = multihost.put(self.mesh, data_spec, xs_all)
+        ys_dev = multihost.put(self.mesh, data_spec, ys_all)
         force((xs_dev, ys_dev, state), all_leaves=True)
         history: list[tuple[int, int, float]] = []
         chunk_rounds = cfg.eval_every if cfg.eval_every else rounds
@@ -484,8 +486,13 @@ class AsyncTrainer:
                     if ckpt and save_crossed(
                         ground, hi - lo, checkpoint_every, hi == rounds
                     ):
+                        # Sharded PS state spans processes in a multi-host
+                        # world; replicate so every process can materialize
+                        # the save (no-op at one process).
                         save_checkpoint(
-                            ckpt, {"state": state},
+                            ckpt,
+                            {"state": multihost.replicate_for_host(
+                                self.mesh, state)},
                             step=epoch * rounds + hi, extra={"epoch": epoch},
                         )
         end = time.perf_counter()

@@ -43,12 +43,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..data import Dataset, one_hot
 from ..models import cnn
 from ..ops import AdamState, adam_init, adam_update
 from ..parallel import collectives as coll
+from ..parallel import multihost
 from ..parallel.layout import LayoutAssignment, assign_layout
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
@@ -296,13 +297,14 @@ def make_sync_epoch(
 
 
 def sharded_adam_init(mesh: Mesh, layout: LayoutAssignment) -> ShardedAdam:
-    """Zero-initialized sharded Adam state, placed ``P(DP_AXIS)``."""
+    """Zero-initialized sharded Adam state, placed ``P(DP_AXIS)``
+    (multi-host-safe: placement goes through ``multihost.put``)."""
     W = mesh.devices.size
-    sharding = NamedSharding(mesh, P(DP_AXIS))
-    z = jnp.zeros((W * layout.max_shard,), jnp.float32)
-    z = jax.device_put(z, sharding)
+    z = multihost.put(
+        mesh, P(DP_AXIS), np.zeros((W * layout.max_shard,), np.float32)
+    )
     return ShardedAdam(
-        step=jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P())),
+        step=multihost.put(mesh, P(), np.zeros((), np.int32)),
         m=z,
         v=jnp.copy(z),
     )
@@ -362,10 +364,10 @@ class SyncTrainer:
         self._shapes = cnn.param_shapes(params)
         sizes = {k: int(np.prod(s)) if s else 1 for k, s in self._shapes.items()}
         self.layout = resolve_layout(config, W, sizes)
-        self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
+        self.params = multihost.put_tree(self.mesh, P(), params)
         if self.layout is None:
-            self.opt_state: Any = jax.device_put(
-                adam_init(params), NamedSharding(self.mesh, P())
+            self.opt_state: Any = multihost.put_tree(
+                self.mesh, P(), adam_init(params)
             )
         else:
             self.opt_state = sharded_adam_init(self.mesh, self.layout)
@@ -401,27 +403,26 @@ class SyncTrainer:
             ys = np.ascontiguousarray(
                 y.reshape(batch_num, W, pb, fy).transpose(1, 0, 2, 3)
             )
-            sharding = NamedSharding(self.mesh, P(DP_AXIS))
+            spec = P(DP_AXIS)
         else:
             xs = x.reshape(batch_num, bs, fx)
             ys = y.reshape(batch_num, bs, fy)
-            sharding = NamedSharding(self.mesh, P())
-        return jax.device_put(xs, sharding), jax.device_put(ys, sharding)
+            spec = P()
+        return (multihost.put(self.mesh, spec, xs),
+                multihost.put(self.mesh, spec, ys))
 
     def _place_state(self, params, opt_state):
         """Re-place host (checkpoint) state onto this trainer's shardings:
         params replicated; Adam state replicated (DP) or m/v mesh-sharded
         (ZeRO-1)."""
-        rep = NamedSharding(self.mesh, P())
-        params = jax.device_put(jax.tree.map(jnp.asarray, params), rep)
+        params = multihost.put_tree(self.mesh, P(), params)
         if self.layout is None:
-            opt_state = jax.device_put(jax.tree.map(jnp.asarray, opt_state), rep)
+            opt_state = multihost.put_tree(self.mesh, P(), opt_state)
         else:
-            shard = NamedSharding(self.mesh, P(DP_AXIS))
             opt_state = ShardedAdam(
-                step=jax.device_put(jnp.asarray(opt_state.step), rep),
-                m=jax.device_put(jnp.asarray(opt_state.m), shard),
-                v=jax.device_put(jnp.asarray(opt_state.v), shard),
+                step=multihost.put(self.mesh, P(), opt_state.step),
+                m=multihost.put(self.mesh, P(DP_AXIS), opt_state.m),
+                v=multihost.put(self.mesh, P(DP_AXIS), opt_state.v),
             )
         return params, opt_state
 
@@ -438,8 +439,10 @@ class SyncTrainer:
         ds = self.dataset
         batch_num = ds.num_train // cfg.batch_size
         xs, ys = self._stage_epoch(batch_num)
-        x_test = jnp.asarray(ds.x_test)
-        y_test = jnp.asarray(one_hot(ds.y_test))
+        # Replicated placement (multi-process: a host-local jnp.asarray would
+        # be device-incompatible with the global params at the first eval).
+        x_test = multihost.put(self.mesh, P(), np.asarray(ds.x_test))
+        y_test = multihost.put(self.mesh, P(), one_hot(ds.y_test))
 
         # Fresh buffers: the chunk programs donate params/opt (on TPU), which
         # must never consume arrays the caller still owns.
@@ -490,8 +493,14 @@ class SyncTrainer:
                     if ckpt and save_crossed(
                         gstep, k, checkpoint_every, first + k == batch_num
                     ):
+                        # Sharded m/v span processes in a multi-host world;
+                        # replicate so every process can materialize the
+                        # save (no-op at one process).
                         save_checkpoint(
-                            ckpt, {"params": params, "opt": opt_state},
+                            ckpt,
+                            {"params": params,
+                             "opt": multihost.replicate_for_host(
+                                 self.mesh, opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
         end = time.perf_counter()
