@@ -101,7 +101,7 @@ def _timed_repeats(compiled, params, opt, xs, ys, rng, *, repeats: int,
     zero = jnp.int32(0)
     # Warmup execution (also materializes the staged batches).
     params, opt, _ = compiled(params, opt, xs, ys, zero, zero, rng)
-    force((params, opt))
+    force((params, opt))  # barrier: the warmup dispatch
 
     out = []
     for rep in range(repeats):

@@ -14,23 +14,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
+# Runnable as a script from anywhere: the package lives at the repo root,
+# one level above this file.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def force_cpu(n: int) -> None:
-    import jax
 
-    try:
-        if len(jax.devices()) >= n and jax.devices()[0].platform == "cpu":
-            return
-    except RuntimeError:
-        pass
-    import jax.extend.backend as jeb
-
-    jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", max(n, 8))
-    jax.config.update("jax_platforms", "cpu")
+from ddl_tpu.parallel.mesh import virtual_cpu_mesh  # noqa: E402
 
 
 def bench_strategy(variant: str, workers: int, steps: int, batch: int) -> float:
@@ -125,14 +118,9 @@ def main() -> int:
     import jax
 
     if args.cpu:
-        force_cpu(args.devices)
+        virtual_cpu_mesh(args.devices, probe=False)
     else:
-        try:
-            n = len(jax.devices())
-        except RuntimeError:
-            n = 0
-        if n < args.devices:
-            force_cpu(args.devices)
+        virtual_cpu_mesh(args.devices, probe=True)
 
     results: dict[str, dict[int, float]] = {}
     widths = [w for w in (1, 2, 4, 8) if w <= args.devices]
@@ -146,14 +134,28 @@ def main() -> int:
             print(f"{variant:15s} W={w}: {ips:10.1f} img/s", flush=True)
 
     base = results["sync_dp"][1]
-    for variant, per_w in results.items():
-        for w, ips in per_w.items():
-            eff = ips / (base * w)
-            print(f"{variant:15s} W={w}: scaling efficiency {eff:5.1%}")
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # Virtual mesh: every "device" shares the host cores, so ideal
+        # strong scaling is CONSTANT img/s at fixed global batch. The
+        # honest proxy metric is the throughput retained vs W=1 — the
+        # algorithmic overhead of the collectives / serve machinery
+        # (ICI bandwidth and real parallel speedup are unmeasurable here).
+        for variant, per_w in results.items():
+            for w, ips in per_w.items():
+                print(f"{variant:15s} W={w}: {ips / base:6.1%} of W=1 "
+                      "throughput retained (1-core proxy; 100% = zero "
+                      "algorithmic overhead)")
+    else:
+        for variant, per_w in results.items():
+            for w, ips in per_w.items():
+                eff = ips / (base * w)
+                print(f"{variant:15s} W={w}: scaling efficiency {eff:5.1%}")
     if args.json:
         with open(args.json, "w") as f:
-            json.dump({"platform": jax.devices()[0].platform,
-                       "batch": args.batch, "results": results}, f, indent=2)
+            json.dump({"platform": platform,
+                       "batch": args.batch, "steps": args.steps,
+                       "results": results}, f, indent=2)
     return 0
 
 

@@ -1,0 +1,134 @@
+"""Time-to-target-accuracy benchmark (BASELINE.md north star).
+
+The reference's only quality signal is eyeballing the accuracy prints
+(mnist_sync/worker.py:71-75 — printed, never recorded; SURVEY.md §6). This
+records it: train the full-width flagship CNN on the 50k-image procedural
+set with the reference's hyperparameters until full-test-set accuracy
+reaches a stated target, and report epochs + training seconds (step time
+only; eval and compile excluded, reference-style `wall` included too).
+
+Trainer ``train()`` calls continue from the trainer's updated state, so the
+benchmark loops whole epochs through the PRODUCT trainers and checks the
+target at every epoch boundary.
+
+Usage:
+    python benchmarks/time_to_accuracy.py --variant single --target 0.99
+    python benchmarks/time_to_accuracy.py --variant sync --workers 1 --bf16
+    python benchmarks/time_to_accuracy.py --variant async --workers 8 --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Runnable as a script from anywhere: the package lives at the repo root,
+# one level above this file.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="single",
+                    choices=["single", "sync", "sync_sharding", "async",
+                             "async_sharding"])
+    ap.add_argument("--target", type=float, default=0.99)
+    ap.add_argument("--max-epochs", type=int, default=20)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--num-ps", type=int, default=2)
+    ap.add_argument("--layout", default="block")
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--eval-every", type=int, default=100,
+                    help="eval cadence in batches (detection granularity)")
+    ap.add_argument("--train", type=int, default=50_000)
+    ap.add_argument("--test", type=int, default=10_000)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU mesh")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args()
+
+    from ddl_tpu.parallel.mesh import virtual_cpu_mesh
+
+    if args.cpu:
+        virtual_cpu_mesh(args.workers, probe=False)
+    elif args.workers > 1:
+        # Multi-worker on the 1-chip bench host needs the virtual mesh.
+        virtual_cpu_mesh(args.workers, probe=True)
+
+    from ddl_tpu.data import load_mnist
+    from ddl_tpu.train.config import TrainConfig
+
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=args.batch,
+        learning_rate=args.lr,
+        eval_every=args.eval_every,
+        num_workers=args.workers,
+        num_ps=args.num_ps if "sharding" in args.variant else 1,
+        layout=args.layout,
+        compute_dtype="bfloat16" if args.bf16 else None,
+    )
+    ds = load_mnist(path=None, synthetic_train=args.train,
+                    synthetic_test=args.test, seed=0)
+    if args.variant == "single":
+        from ddl_tpu.train.trainer import SingleChipTrainer
+
+        trainer = SingleChipTrainer(cfg, ds)
+    elif args.variant.startswith("sync"):
+        from ddl_tpu.strategies.sync import SyncTrainer
+
+        trainer = SyncTrainer(cfg, ds)
+    else:
+        from ddl_tpu.strategies.async_ps import AsyncTrainer
+
+        trainer = AsyncTrainer(cfg, ds)
+
+    t_wall0 = time.perf_counter()
+    train_s = compile_s = 0.0
+    acc = 0.0
+    epochs = 0
+    trace = []
+    for epoch in range(args.max_epochs):
+        r = trainer.train(log=lambda s: None)
+        epochs += 1
+        train_s += r.train_time_s
+        compile_s += r.compile_time_s
+        acc = r.final_accuracy
+        trace.append(round(acc, 4))
+        print(f"[tta] epoch {epochs}: accuracy {acc:.4f} "
+              f"(train {train_s:.2f}s)", file=sys.stderr)
+        if acc >= args.target:
+            break
+    wall = time.perf_counter() - t_wall0
+
+    result = {
+        "metric": "time_to_accuracy",
+        "variant": args.variant,
+        "target": args.target,
+        "reached": acc >= args.target,
+        "final_accuracy": round(acc, 4),
+        "epochs": epochs,
+        "train_time_s": round(train_s, 2),
+        "wall_time_s": round(wall, 2),
+        "compile_time_s": round(compile_s, 2),
+        "accuracy_per_epoch": trace,
+        "config": {
+            "workers": args.workers, "batch": args.batch, "lr": args.lr,
+            "bf16": args.bf16, "train_images": args.train,
+            "num_ps": cfg.num_ps, "layout": cfg.layout,
+        },
+    }
+    print(json.dumps(result))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(result, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

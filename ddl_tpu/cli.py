@@ -214,12 +214,14 @@ def _default_workers(variant: str) -> int:
         )
 
 
-def _ensure_devices(n: int, *, allow_fallback: bool = True) -> None:
+def _ensure_devices(n: int, *, allow_fallback: bool = True,
+                    reason: str = "drop --platform") -> None:
     """If the active platform has fewer than ``n`` devices (e.g. one real
     TPU chip), fall back to a virtual n-device CPU mesh so every strategy
-    is runnable anywhere. With ``allow_fallback=False`` (the user passed an
-    explicit ``--platform``) a shortfall is an error, never a silent
-    platform swap."""
+    is runnable anywhere. With ``allow_fallback=False`` (explicit
+    ``--platform``, or ``--multihost`` — where swapping to a private local
+    mesh would silently break each process out of the shared world) a
+    shortfall is an error, never a silent platform swap."""
     import jax
 
     err = None
@@ -231,14 +233,11 @@ def _ensure_devices(n: int, *, allow_fallback: bool = True) -> None:
     if not allow_fallback:
         have = "unavailable" if err is not None else f"{len(jax.devices())} devices"
         raise SystemExit(
-            f"requested platform cannot provide {n} devices ({have}); "
-            "drop --platform to allow the virtual-CPU-mesh fallback"
+            f"active platform cannot provide {n} devices ({have}); {reason}"
         )
-    import jax.extend.backend as jeb
+    from .parallel.mesh import virtual_cpu_mesh
 
-    jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", max(n, 8))
-    jax.config.update("jax_platforms", "cpu")
+    virtual_cpu_mesh(n, probe=True)
     print(f"[ddl_tpu] falling back to {len(jax.devices())}-device virtual CPU mesh")
 
 
@@ -271,7 +270,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     cfg = config_from_args(args)
     if args.variant != "single":
-        _ensure_devices(cfg.num_workers, allow_fallback=args.platform is None)
+        if args.multihost:
+            # Never swap a multihost process onto a private virtual mesh —
+            # each process would silently train an independent copy.
+            _ensure_devices(
+                cfg.num_workers, allow_fallback=False,
+                reason="use --num-workers <= the world's global device "
+                       "count (the virtual-CPU fallback is disabled under "
+                       "--multihost)",
+            )
+        else:
+            _ensure_devices(
+                cfg.num_workers, allow_fallback=args.platform is None,
+                reason="drop --platform to allow the virtual-CPU-mesh "
+                       "fallback",
+            )
 
     if args.variant == "single":
         from .train.trainer import SingleChipTrainer

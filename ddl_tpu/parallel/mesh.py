@@ -40,6 +40,46 @@ def make_mesh(
     return Mesh(np.asarray(devices), (axis,))
 
 
+def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
+    """Point JAX at an ``n``-device virtual CPU platform — the hermetic
+    surface every multi-chip strategy runs on when real chips are absent
+    (tests, CI, smoke runs, the driver dryrun).
+
+    ``probe=False`` sets the config BEFORE any backend initializes and must
+    be used when CPU was explicitly requested: probing ``jax.devices()``
+    first would initialize the default backend — on this host the axon TPU
+    tunnel, whose remote handshake can block for minutes and is never
+    needed for a CPU run. ``probe=True`` pays that init to return early
+    when the active platform already has ``n`` devices, else clears the
+    backends and switches.
+
+    (The tunnel's sitecustomize forces ``jax_platforms`` programmatically,
+    so plain ``JAX_PLATFORMS=cpu`` env vars cannot do this.)
+    """
+    import os
+
+    import jax
+
+    if probe:
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # The caller's environment explicitly asked for CPU (e.g. the
+            # driver dryrun); honor it over the sitecustomize override
+            # BEFORE probing, or the probe itself would initialize the
+            # TPU tunnel backend — a remote handshake that can block
+            # indefinitely when the tunnel is down.
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            if len(jax.devices()) >= n:
+                return
+        except RuntimeError:
+            pass
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+    jax.config.update("jax_num_cpu_devices", max(n, 8))
+    jax.config.update("jax_platforms", "cpu")
+
+
 def donation_for(mesh: Mesh, *argnums: int) -> tuple[int, ...]:
     """Buffer-donation argnums for a jitted step on this mesh.
 

@@ -388,13 +388,17 @@ class AsyncTrainer:
 
     def _gather_ps(self, state: AsyncState) -> jax.Array:
         """Authoritative flat param vector from the PS state: the owner-major
-        chunks reassembled to flat (layout) order when sharded."""
+        chunks reassembled to flat (layout) order when sharded. Returned
+        mesh-replicated, so downstream eval never mixes it with host-local
+        arrays (jit rejects mixed device sets)."""
         if self.layout is None:
             return state.ps
         # Host gather of [W * chunk]; replicate first so the shards are
         # addressable from every process (no-op at one process).
         flat = np.asarray(multihost.replicate_for_host(self.mesh, state.ps))
-        return jnp.asarray(flat[coll.reassembly_index(self.layout)])
+        return multihost.put(
+            self.mesh, P(), flat[coll.reassembly_index(self.layout)]
+        )
 
     def _place_state(self, state: AsyncState) -> AsyncState:
         """Re-place host (checkpoint) state onto this trainer's shardings."""
@@ -477,7 +481,7 @@ class AsyncTrainer:
                         state, ps_full, _ = compiled[hi - lo](
                             state, xs_dev[lo:hi], ys_dev[lo:hi], rngs, sched
                         )
-                        force(ps_full)
+                        force(ps_full)  # barrier: the compiled[...] round dispatch
                     if cfg.eval_every:
                         params = self._unflatten(ps_full)
                         acc = evaluate(params, x_test, y_test)

@@ -484,7 +484,7 @@ class SyncTrainer:
                             jnp.int32(first), jnp.int32(gstep),
                             self.dropout_key,
                         )
-                        force(params)
+                        force(params)  # barrier: the fns[k] span dispatch
                     if eval_after:
                         cnt = first + k - 1
                         acc = evaluate(params, x_test, y_test)

@@ -77,11 +77,17 @@ def force(tree, *, all_leaves: bool = False) -> None:
     same execution.
 
     Default: fetch from the FIRST leaf only — correct (and one round-trip
-    cheap) when ``tree`` is the output of a single executable, i.e. every
-    timed-loop boundary. ``all_leaves=True`` fetches one scalar per leaf —
-    needed when leaves come from independent dispatches (staged uploads,
-    per-leaf ``jnp.copy`` trees); use it outside timed regions, since each
-    fetch costs a host round-trip.
+    cheap) ONLY when ``tree`` is the output of a single executable, i.e.
+    every timed-loop boundary. ``all_leaves=True`` fetches one scalar per
+    leaf — needed when leaves come from independent dispatches (staged
+    uploads, per-leaf ``jnp.copy`` trees); use it outside timed regions,
+    since each fetch costs a host round-trip.
+
+    Single-executable contract: every ``all_leaves=False`` call site must
+    pass the output of exactly ONE compiled dispatch and carries a
+    ``# barrier: ...`` comment naming that dispatch, so the assumption is
+    reviewable by grep — a call at a boundary joining independent
+    dispatches would silently under-synchronize on the tunnel backend.
     """
     leaves = [
         l for l in jax.tree_util.tree_leaves(tree)
@@ -308,7 +314,7 @@ class SingleChipTrainer:
                             jnp.int32(first), jnp.int32(gstep),
                             self.dropout_key,
                         )
-                        force(params)
+                        force(params)  # barrier: the fns[k] span dispatch
                     if eval_after:
                         cnt = first + k - 1
                         acc = evaluate(params, x_test, y_test)
