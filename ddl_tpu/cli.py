@@ -73,6 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="procedural test-set size when --data is absent")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (MXU fast path)")
+    p.add_argument("--fused-adam", action="store_true",
+                   help="use the hand-fused Pallas Adam kernel for the "
+                        "sharded update (default: XLA-fused; see "
+                        "benchmarks/adam_kernel.py for the comparison)")
     p.add_argument("--conv-channels", type=_int_tuple, default=None,
                    metavar="C1,C2,C3,C4",
                    help="conv widths of the model family (default "
@@ -195,6 +199,7 @@ def config_from_args(args) -> "TrainConfig":
         shard_data=shard_data,
         staleness_seed=args.staleness_seed,
         compute_dtype="bfloat16" if args.bf16 else None,
+        fused_adam=args.fused_adam,
         conv_channels=conv_channels or (32, 64, 128, 256),
         fc_sizes=fc_sizes or (1024, 512),
     )

@@ -1,0 +1,93 @@
+"""Fused Adam update as a Pallas TPU kernel.
+
+The sharded (ZeRO-1) update applies TF1-semantics Adam to each device's
+owned slice of the flat parameter vector
+(strategies/sync.py ``_adam_flat``; reference optimizer:
+Adam(1e-4) at mnist_sync/model/model.py:93 applied per PS shard at
+mnist_sync_sharding/parameter_server.py:56-69). XLA already fuses this
+elementwise chain well; this kernel is the hand-fused alternative
+(VERDICT r2 task 9): ONE pass over HBM reading g/m/v/p and writing
+p'/m'/v' in (block_rows, 128) VMEM tiles, with the step-dependent learning
+rate in SMEM. ``benchmarks/adam_kernel.py`` measures it against the
+XLA-fused version; tests pin bit-compatibility in interpreter mode.
+
+The math is token-identical to ``_adam_flat``:
+
+    m' = b1*m + (1-b1)*g
+    v' = b2*v + (1-b2)*g*g
+    p' = p - lr_t * m' / (sqrt(v') + eps)
+
+so the two paths agree to ~1 ulp — exact bit-equality across separately
+compiled programs is not guaranteed (fusion may reassociate the
+multiply-adds); ``tests/test_pallas_adam.py`` pins the tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128  # TPU lane width: last dim of every VMEM tile
+DEFAULT_BLOCK_ROWS = 512  # (512, 128) f32 tiles = 256 KiB per operand
+
+
+def _adam_kernel(b1, b2, eps, lr_ref, g_ref, m_ref, v_ref, p_ref,
+                 p_out, m_out, v_out):
+    lr_t = lr_ref[0]
+    g = g_ref[:]
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    m_out[:] = m
+    v_out[:] = v
+    p_out[:] = p_ref[:] - lr_t * m / (jnp.sqrt(v) + eps)
+
+
+def adam_flat_fused(
+    p: jax.Array,
+    m: jax.Array,
+    v: jax.Array,
+    g: jax.Array,
+    lr_t: jax.Array,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused Adam step over flat f32 vectors ``[n]``.
+
+    ``lr_t`` is the bias-corrected scalar learning rate (computed by the
+    caller exactly as ``_adam_flat`` does — the step counter stays outside
+    the kernel). Returns ``(p', m', v')``. ``interpret=True`` runs the
+    Pallas interpreter — the CPU-testable path.
+    """
+    n = p.shape[0]
+    block = block_rows * LANES
+    padded = -(-max(n, 1) // block) * block
+    rows = padded // LANES
+
+    def pad2d(a):
+        return jnp.pad(a, (0, padded - n)).reshape(rows, LANES)
+
+    spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, LANES), p.dtype)
+    p2, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_kernel, b1, b2, eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lr_t, whole (1,)
+            spec, spec, spec, spec,
+        ],
+        out_specs=(spec, spec, spec),
+        out_shape=(out_shape, out_shape, out_shape),
+        interpret=interpret,
+    )(jnp.reshape(lr_t, (1,)).astype(p.dtype), pad2d(g), pad2d(m), pad2d(v),
+      pad2d(p))
+    unpad = lambda a: a.reshape(padded)[:n]
+    return unpad(p2), unpad(m2), unpad(v2)

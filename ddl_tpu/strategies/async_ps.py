@@ -63,6 +63,7 @@ from ..train.trainer import (
     checkpoint_file,
     evaluate,
     force,
+    hit_target,
     save_crossed,
     try_resume,
 )
@@ -461,6 +462,7 @@ class AsyncTrainer:
                 ).compile()
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
+        stopped = False
         start = time.perf_counter()
         ps_full = None
         with trace(profile_dir):
@@ -487,8 +489,10 @@ class AsyncTrainer:
                         acc = evaluate(params, x_test, y_test)
                         history.append((epoch, lo, acc))
                         log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
+                        stopped = hit_target(cfg, acc)
                     if ckpt and save_crossed(
-                        ground, hi - lo, checkpoint_every, hi == rounds
+                        ground, hi - lo, checkpoint_every,
+                        hi == rounds or stopped,
                     ):
                         # Sharded PS state spans processes in a multi-host
                         # world; replicate so every process can materialize
@@ -499,6 +503,11 @@ class AsyncTrainer:
                                 self.mesh, state)},
                             step=epoch * rounds + hi, extra={"epoch": epoch},
                         )
+                    if stopped:
+                        break
+                if stopped:
+                    log(f"target accuracy {cfg.target_accuracy} reached")
+                    break
         end = time.perf_counter()
         train_time = timer.total_s
         if ps_full is None:  # fully-resumed run: nothing left to execute

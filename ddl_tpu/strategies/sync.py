@@ -59,6 +59,7 @@ from ..train.trainer import (
     eval_spans,
     evaluate,
     force,
+    hit_target,
     save_crossed,
     try_resume,
 )
@@ -83,11 +84,25 @@ class ShardedAdam:
     v: jax.Array
 
 
-def _adam_flat(p, state: ShardedAdam, g, *, lr, b1=0.9, b2=0.999, eps=1e-8):
-    """TF1-semantics Adam (see ddl_tpu.ops.optimizers) on flat slices."""
+def _adam_flat(p, state: ShardedAdam, g, *, lr, b1=0.9, b2=0.999, eps=1e-8,
+               fused=False, pallas_interpret=False):
+    """TF1-semantics Adam (see ddl_tpu.ops.optimizers) on flat slices.
+
+    ``fused=True`` routes through the hand-fused Pallas kernel
+    (ops/pallas_adam.py, ~1-ulp-equivalent); the default is the XLA-fused
+    elementwise chain. ``pallas_interpret`` selects the interpreter (the
+    CPU-testable path) for the kernel."""
     step = state.step + 1
     t = step.astype(jnp.float32)
     lr_t = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+    if fused:
+        from ..ops.pallas_adam import adam_flat_fused
+
+        p_new, m, v = adam_flat_fused(
+            p, state.m, state.v, g, lr_t, b1=b1, b2=b2, eps=eps,
+            interpret=pallas_interpret,
+        )
+        return p_new, ShardedAdam(step=step, m=m, v=v)
     m = b1 * state.m + (1.0 - b1) * g
     v = b2 * state.v + (1.0 - b2) * g * g
     return p - lr_t * m / (jnp.sqrt(v) + eps), ShardedAdam(step=step, m=m, v=v)
@@ -167,7 +182,9 @@ def make_sharded_step(
     with ``psum`` then slice the unequal owner range (padded to max_shard).
     """
     W = mesh.devices.size
-    step = _sharded_step_body(config, W, layout, shapes)
+    interp = mesh.devices.flat[0].platform != "tpu"
+    step = _sharded_step_body(config, W, layout, shapes,
+                              pallas_interpret=interp)
     data_spec = P(DP_AXIS) if config.shard_data else P()
     smapped = jax.shard_map(
         step,
@@ -184,8 +201,12 @@ def _sharded_step_body(
     W: int,
     layout: LayoutAssignment,
     shapes: Mapping[str, tuple[int, ...]] | None = None,
+    *,
+    pallas_interpret: bool = False,
 ) -> Callable:
-    """Raw per-device ZeRO-1 step (usable inside shard_map)."""
+    """Raw per-device ZeRO-1 step (usable inside shard_map).
+    ``pallas_interpret`` runs the fused-Adam Pallas kernel (when
+    ``config.fused_adam``) in interpreter mode — required off-TPU."""
     spec = coll.FlatSpec.from_layout(layout, shapes or dict(cnn.PARAM_SPECS))
     mean = config.grad_reduction == "mean"
     # The fused psum_scatter path needs one equal chunk per mesh device.
@@ -221,7 +242,10 @@ def _sharded_step_body(
         p_own = lax.dynamic_slice(
             jnp.pad(p_flat, (0, pad_len - layout.total)), (my_start,), (chunk,)
         )
-        p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
+        p_new, opt = _adam_flat(
+            p_own, opt, g_own, lr=config.learning_rate,
+            fused=config.fused_adam, pallas_interpret=pallas_interpret,
+        )
 
         gathered = lax.all_gather(p_new, DP_AXIS, tiled=True)  # [W * chunk]
         if equal_chunks:
@@ -263,7 +287,10 @@ def make_sync_epoch(
         step = _dp_step_body(config, W)
         opt_spec: Any = P()
     else:
-        step = _sharded_step_body(config, W, layout, shapes)
+        step = _sharded_step_body(
+            config, W, layout, shapes,
+            pallas_interpret=mesh.devices.flat[0].platform != "tpu",
+        )
         opt_spec = ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS))
     data_spec = P(DP_AXIS) if config.shard_data else P()
 
@@ -471,6 +498,7 @@ class SyncTrainer:
         }
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
+        stopped = False
         start = time.perf_counter()
         with trace(profile_dir):
             for epoch in range(cfg.epochs):
@@ -490,8 +518,10 @@ class SyncTrainer:
                         acc = evaluate(params, x_test, y_test)
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                        stopped = hit_target(cfg, acc)
                     if ckpt and save_crossed(
-                        gstep, k, checkpoint_every, first + k == batch_num
+                        gstep, k, checkpoint_every,
+                        first + k == batch_num or stopped,
                     ):
                         # Sharded m/v span processes in a multi-host world;
                         # replicate so every process can materialize the
@@ -503,6 +533,11 @@ class SyncTrainer:
                                  self.mesh, opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
+                    if stopped:
+                        break
+                if stopped:
+                    log(f"target accuracy {cfg.target_accuracy} reached")
+                    break
         end = time.perf_counter()
         train_time = timer.total_s
         final_acc = evaluate(params, x_test, y_test)

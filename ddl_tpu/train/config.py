@@ -57,6 +57,19 @@ class TrainConfig:
     # None = fp32 (reference parity); "bfloat16" engages the MXU fast path.
     compute_dtype: str | None = None
 
+    # Sharded update: use the hand-fused Pallas Adam kernel instead of the
+    # XLA-fused elementwise chain (ops/pallas_adam.py; ~1-ulp-equivalent,
+    # measured against XLA by benchmarks/adam_kernel.py).
+    fused_adam: bool = False
+
+    # Early stop: end training at the first eval whose full-test-set
+    # accuracy reaches this target (None = run all epochs). Evals happen
+    # every ``eval_every`` batches — that is the detection granularity.
+    # Powers benchmarks/time_to_accuracy.py; the reference can only be
+    # eyeballed to its target (accuracy printed, never acted on,
+    # mnist_sync/worker.py:71-75).
+    target_accuracy: float | None = None
+
     # Model family: widths of the reference CNN architecture (defaults
     # reproduce the reference exactly — mnist_sync/model/model.py:24-88).
     # Narrower widths give a structurally identical 14-variable model at a

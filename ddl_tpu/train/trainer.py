@@ -190,6 +190,15 @@ def try_resume(
     return tree, step
 
 
+def hit_target(config: TrainConfig, accuracy: float) -> bool:
+    """Early-stop predicate: ``config.target_accuracy`` reached at an eval
+    point (the detection granularity is ``eval_every`` batches)."""
+    return (
+        config.target_accuracy is not None
+        and accuracy >= config.target_accuracy
+    )
+
+
 def save_crossed(gstep: int, k: int, every: int, epoch_end: bool) -> bool:
     """Checkpoint cadence: save at every epoch end, plus whenever the span
     ``[gstep, gstep+k)`` crosses a multiple of ``every`` (0 = epoch-end
@@ -301,6 +310,7 @@ class SingleChipTrainer:
         }
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
+        stopped = False
         start = time.perf_counter()
         with trace(profile_dir):
             for epoch in range(cfg.epochs):
@@ -320,13 +330,20 @@ class SingleChipTrainer:
                         acc = evaluate(params, x_test, y_test)
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                        stopped = hit_target(cfg, acc)
                     if ckpt and save_crossed(
-                        gstep, k, checkpoint_every, first + k == batch_num
+                        gstep, k, checkpoint_every,
+                        first + k == batch_num or stopped,
                     ):
                         save_checkpoint(
                             ckpt, {"params": params, "opt": opt_state},
                             step=gstep + k, extra={"epoch": epoch},
                         )
+                    if stopped:
+                        break
+                if stopped:
+                    log(f"target accuracy {cfg.target_accuracy} reached")
+                    break
         end = time.perf_counter()
         train_time = timer.total_s
         final_acc = evaluate(params, x_test, y_test)

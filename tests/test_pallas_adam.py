@@ -1,0 +1,118 @@
+"""Fused Pallas Adam kernel (ops/pallas_adam.py): equivalence with the
+XLA-fused update at ~1-ulp tolerance (exact bit-equality across separately
+compiled programs is not guaranteed — fusion may reassociate the
+multiply-adds), padding correctness at awkward sizes, and the
+config.fused_adam product path end-to-end on the 8-device mesh.
+
+On the CPU test platform the kernel runs in Pallas interpreter mode (the
+trainers select this automatically from the mesh platform).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models import cnn
+from ddl_tpu.ops.pallas_adam import adam_flat_fused
+from ddl_tpu.parallel.mesh import DP_AXIS, make_mesh
+from ddl_tpu.strategies.sync import (
+    SyncTrainer,
+    make_sharded_step,
+    resolve_layout,
+    sharded_adam_init,
+)
+from ddl_tpu.train import TrainConfig
+
+
+def _oracle(p, m, v, g, lr_t, b1=0.9, b2=0.999, eps=1e-8):
+    m2 = b1 * m + (1.0 - b1) * g
+    v2 = b2 * v + (1.0 - b2) * g * g
+    return p - lr_t * m2 / (jnp.sqrt(v2) + eps), m2, v2
+
+
+@pytest.mark.parametrize("n", [5, 1024, 512 * 128, 512 * 128 + 17])
+def test_fused_matches_xla_chain(n, rng):
+    """Sizes cover sub-tile, single-tile, exact-grid, and padded-grid."""
+    p, m, g = (jnp.asarray(rng.normal(size=n), jnp.float32) for _ in range(3))
+    v = jnp.abs(jnp.asarray(rng.normal(size=n), jnp.float32))
+    lr_t = jnp.float32(3e-4)
+    p_r, m_r, v_r = _oracle(p, m, v, g, lr_t)
+    p_f, m_f, v_f = adam_flat_fused(p, m, v, g, lr_t, interpret=True)
+    np.testing.assert_allclose(np.asarray(p_f), np.asarray(p_r), atol=2e-7)
+    np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_r), atol=2e-7)
+    np.testing.assert_allclose(np.asarray(v_f), np.asarray(v_r), atol=2e-7)
+
+
+def test_padding_tail_not_leaked(rng):
+    """Values past n must never contaminate results for any block layout."""
+    n = 300  # well inside one (512, 128) block
+    args = [jnp.asarray(rng.normal(size=n), jnp.float32) for _ in range(3)]
+    v = jnp.abs(args.pop())
+    p, m = args
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    small = adam_flat_fused(p, m, v, g, jnp.float32(1e-3), block_rows=8,
+                            interpret=True)
+    big = adam_flat_fused(p, m, v, g, jnp.float32(1e-3), interpret=True)
+    for a, b in zip(small, big):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-7)
+        assert a.shape == (n,)
+
+
+def test_sharded_step_fused_matches_default(small_params, small_dataset):
+    """The product path: make_sharded_step with config.fused_adam on the
+    8-device mesh ≡ the XLA-fused default, for a variable-aligned layout
+    (padding exercised via max_shard)."""
+    W = 8
+    mesh = make_mesh(W)
+    shapes = cnn.param_shapes(small_params)
+    sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
+    base = dict(num_workers=W, num_ps=4, layout="zigzag", batch_size=32,
+                keep_prob=1.0, seed=0)
+    x = jnp.asarray(np.asarray(small_dataset.x_train[:32]))
+    y = jnp.asarray(
+        np.eye(10, dtype=np.float32)[np.asarray(small_dataset.y_train[:32])]
+    )
+    data_sh = NamedSharding(mesh, P(DP_AXIS))
+    x, y = jax.device_put(x, data_sh), jax.device_put(y, data_sh)
+    params0 = jax.device_put(small_params, NamedSharding(mesh, P()))
+    rng_key = jax.random.PRNGKey(7)
+
+    results = {}
+    for fused in (False, True):
+        cfg = TrainConfig(fused_adam=fused, **base)
+        layout = resolve_layout(cfg, W, sizes)
+        step = make_sharded_step(cfg, mesh, layout, shapes)
+        opt = sharded_adam_init(mesh, layout)
+        p, opt, loss = step(params0, opt, x, y, rng_key)
+        p, opt, loss = step(p, opt, x, y, jax.random.fold_in(rng_key, 1))
+        results[fused] = (p, opt, float(loss))
+
+    (p0, o0, l0), (p1, o1, l1) = results[False], results[True]
+    # Step 2's loss is computed from step-1 params, which may already
+    # differ ~1 ulp between the paths — tolerance, not bit-equality.
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    for k in p0:
+        np.testing.assert_allclose(
+            np.asarray(p0[k]), np.asarray(p1[k]), atol=1e-6, err_msg=k
+        )
+    np.testing.assert_allclose(np.asarray(o0.m), np.asarray(o1.m), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o0.v), np.asarray(o1.v), atol=1e-6)
+    assert int(o1.step) == 2
+
+
+def test_sync_trainer_fused_end_to_end(small_dataset, small_params):
+    """SyncTrainer with fused_adam trains and stays close to the default
+    path over a short run (divergence bounded by ulp-level update noise)."""
+    kw = dict(num_workers=8, num_ps=8, layout="flat", batch_size=256,
+              epochs=1, eval_every=0, seed=3)
+    r0 = SyncTrainer(
+        TrainConfig(**kw), small_dataset, init=small_params
+    ).train(log=lambda s: None)
+    r1 = SyncTrainer(
+        TrainConfig(fused_adam=True, **kw), small_dataset, init=small_params
+    ).train(log=lambda s: None)
+    for k in r0.params:
+        np.testing.assert_allclose(r0.params[k], r1.params[k], atol=1e-5,
+                                   err_msg=k)
