@@ -78,10 +78,16 @@ def main() -> int:
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args()
 
-    from ddl_tpu.parallel.mesh import virtual_cpu_mesh
+    from ddl_tpu.parallel.mesh import backend_ready, virtual_cpu_mesh
 
     if args.cpu:
         virtual_cpu_mesh(1, probe=False)
+    elif not backend_ready():
+        print(json.dumps({"metric": "adam_update_fused_vs_xla",
+                          "error": "default JAX backend unreachable (TPU "
+                                   "tunnel down?) — no measurement taken"}),
+              flush=True)
+        os._exit(1)
 
     import jax
 

@@ -172,6 +172,12 @@ def config_from_args(args) -> "TrainConfig":
             f"multiple of {num_workers}, drop --batch-size to auto-round, "
             f"or pass --reference-compat for replicated data."
         )
+    if args.fused_adam and not (sharded and args.variant.startswith("sync")):
+        raise SystemExit(
+            "--fused-adam applies to the ZeRO-1 sharded sync update only "
+            "(sync_sharding / sync_sharding_greedy); other variants use "
+            "different update programs and would silently ignore it"
+        )
     conv_channels = args.conv_channels
     fc_sizes = args.fc_sizes
     if args.tiny:

@@ -67,19 +67,25 @@ def adam_flat_fused(
     Pallas interpreter — the CPU-testable path.
     """
     n = p.shape[0]
-    block = block_rows * LANES
-    padded = -(-max(n, 1) // block) * block
+    padded = -(-max(n, 1) // LANES) * LANES
     rows = padded // LANES
+    aligned = padded == n
 
-    def pad2d(a):
-        return jnp.pad(a, (0, padded - n)).reshape(rows, LANES)
+    def to2d(a):
+        # Lane-aligned inputs (the product path: layout.max_shard rounds
+        # shard slices up to the lane width) reshape for FREE — no HBM
+        # copy; only unaligned generic inputs pay a pad. The ragged tail
+        # of the row grid is handled by Pallas edge-block masking.
+        if not aligned:
+            a = jnp.pad(a, (0, padded - n))
+        return a.reshape(rows, LANES)
 
     spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((rows, LANES), p.dtype)
     p2, m2, v2 = pl.pallas_call(
         functools.partial(_adam_kernel, b1, b2, eps),
-        grid=(rows // block_rows,),
+        grid=(-(-rows // block_rows),),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # lr_t, whole (1,)
             spec, spec, spec, spec,
@@ -87,7 +93,7 @@ def adam_flat_fused(
         out_specs=(spec, spec, spec),
         out_shape=(out_shape, out_shape, out_shape),
         interpret=interpret,
-    )(jnp.reshape(lr_t, (1,)).astype(p.dtype), pad2d(g), pad2d(m), pad2d(v),
-      pad2d(p))
-    unpad = lambda a: a.reshape(padded)[:n]
+    )(jnp.reshape(lr_t, (1,)).astype(p.dtype), to2d(g), to2d(m), to2d(v),
+      to2d(p))
+    unpad = lambda a: a.reshape(padded) if aligned else a.reshape(padded)[:n]
     return unpad(p2), unpad(m2), unpad(v2)

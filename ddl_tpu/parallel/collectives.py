@@ -92,11 +92,15 @@ def pad_to(flat: jax.Array, padded_total: int) -> jax.Array:
 
 
 def reduce_scatter_flat(
-    flat: jax.Array, num_shards: int, axis: str, *, mean: bool
+    flat: jax.Array, num_shards: int, axis: str, *, mean: bool,
+    chunk: int | None = None
 ) -> jax.Array:
     """Inside shard_map: fused reduce-scatter of a (padded) flat vector.
-    Returns this device's reduced chunk ``[chunk]``."""
-    chunk = chunk_size(flat.shape[0], num_shards)
+    Returns this device's reduced chunk ``[chunk]``. Pass the layout's
+    ``max_shard`` as ``chunk`` so the row split matches the flat layout's
+    lane-aligned shard boundaries."""
+    if chunk is None:
+        chunk = chunk_size(flat.shape[0], num_shards)
     padded = pad_to(flat, chunk * num_shards)
     shard = lax.psum_scatter(
         padded.reshape(num_shards, chunk), axis, scatter_dimension=0, tiled=False

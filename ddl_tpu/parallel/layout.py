@@ -41,6 +41,17 @@ Policy = str  # "block" | "zigzag" | "lpt" | "flat"
 
 POLICIES = ("block", "zigzag", "lpt", "flat")
 
+# TPU lane width. Per-shard slice lengths (max_shard) round up to this so
+# every shard slice is tile-aligned end-to-end — reduce-scatter chunks,
+# Adam state, reassembly, and the fused Pallas kernels all share the same
+# aligned length and need no repacking copies. Cost: <= LANE-1 padded
+# elements per shard.
+LANE = 128
+
+
+def align_lane(n: int) -> int:
+    return -(-n // LANE) * LANE
+
 
 def block_order(names: list[str], sizes: dict[str, int]) -> list[str]:
     """Identity permutation (reference creation order)."""
@@ -102,12 +113,14 @@ class LayoutAssignment:
 
     @property
     def max_shard(self) -> int:
-        return max(self.shard_sizes)
+        """Per-shard slice length: the largest shard size, lane-aligned
+        (see LANE above)."""
+        return align_lane(max(self.shard_sizes))
 
     @property
     def balance(self) -> float:
-        """max/mean shard load — 1.0 is perfect."""
-        return self.max_shard / (self.total / self.num_shards)
+        """max/mean shard load — 1.0 is perfect (true sizes, unaligned)."""
+        return max(self.shard_sizes) / (self.total / self.num_shards)
 
     def summary(self) -> str:
         return (
@@ -138,9 +151,12 @@ def assign_layout(
 
     if policy == "flat":
         order = list(names)
-        chunk = -(-total // num_shards)  # ceil: equal padded shards
+        # ceil then lane-align: equal padded shards whose boundaries match
+        # the psum_scatter row split (collectives.reduce_scatter_flat with
+        # chunk=max_shard).
+        chunk = align_lane(-(-total // num_shards))
         starts = [min(s * chunk, total) for s in range(num_shards)]
-        sz = [min(chunk, total - st) for st in starts]
+        sz = [max(0, min(chunk, total - st)) for st in starts]
         var_to_shard = None
     else:
         if policy == "block":

@@ -80,6 +80,27 @@ def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def backend_ready(timeout_s: float = 240.0) -> bool:
+    """Probe the default backend with a watchdog thread. The axon tunnel's
+    remote handshake can block INDEFINITELY when the tunnel is down; a
+    benchmark that hangs forever is worse than one that reports the outage.
+    NB when this returns False the probe thread is stuck in native code —
+    callers must exit via ``os._exit`` (after flushing stdout)."""
+    import threading
+
+    ok: list[int] = []
+
+    def probe():
+        import jax
+
+        ok.append(len(jax.devices()))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return bool(ok)
+
+
 def donation_for(mesh: Mesh, *argnums: int) -> tuple[int, ...]:
     """Buffer-donation argnums for a jitted step on this mesh.
 

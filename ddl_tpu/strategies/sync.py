@@ -228,7 +228,9 @@ def _sharded_step_body(
         p_flat = coll.flatten_params(params, spec)
 
         if equal_chunks:
-            g_own = coll.reduce_scatter_flat(g_flat, W, DP_AXIS, mean=mean)
+            g_own = coll.reduce_scatter_flat(
+                g_flat, W, DP_AXIS, mean=mean, chunk=chunk
+            )
             my_start = lax.axis_index(DP_AXIS) * chunk
         else:
             g_red = lax.psum(g_flat, DP_AXIS)

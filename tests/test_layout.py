@@ -94,10 +94,21 @@ def test_lpt_order_groups_by_shard():
 
 def test_flat_equal_chunks():
     a = assign_layout("flat", 8, NAMES, SIZES)
-    chunk = -(-a.total // 8)
+    # Shard boundaries are the ceil-split rounded UP to the TPU lane width
+    # (128), so every shard slice is tile-aligned (layout.LANE).
+    chunk = -(-(-(-a.total // 8)) // 128) * 128
     assert a.max_shard == chunk
-    assert a.balance == pytest.approx(chunk / (a.total / 8))
+    assert a.shard_starts == tuple(min(s * chunk, a.total) for s in range(8))
+    assert a.balance == pytest.approx(max(a.shard_sizes) / (a.total / 8))
     assert a.var_to_shard is None
+
+
+def test_max_shard_lane_aligned():
+    for policy, shards in (("block", 4), ("zigzag", 7), ("lpt", 3), ("flat", 8)):
+        a = assign_layout(policy, shards, NAMES, SIZES)
+        assert a.max_shard % 128 == 0
+        assert a.max_shard >= max(a.shard_sizes)
+        assert a.max_shard - max(a.shard_sizes) < 128
 
 
 def test_reassembly_index_roundtrip():
