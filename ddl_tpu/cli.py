@@ -49,7 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel degree (default: all devices)")
     p.add_argument("--num-ps", type=int, default=2,
                    help="parameter shard count for *_sharding variants "
-                        "(reference run.sh arg $1)")
+                        "(reference run.sh arg $1; any split works — more "
+                        "shards than workers fold round-robin onto the mesh)")
     p.add_argument("--layout", default=None,
                    choices=["block", "zigzag", "lpt", "flat"],
                    help="shard layout policy (default: block for *_sharding, "
@@ -172,10 +173,13 @@ def config_from_args(args) -> "TrainConfig":
             f"multiple of {num_workers}, drop --batch-size to auto-round, "
             f"or pass --reference-compat for replicated data."
         )
-    if args.fused_adam and not (sharded and args.variant.startswith("sync")):
+    if args.fused_adam and not (
+        sharded and args.variant.startswith("sync") and args.num_ps > 1
+    ):
         raise SystemExit(
             "--fused-adam applies to the ZeRO-1 sharded sync update only "
-            "(sync_sharding / sync_sharding_greedy); other variants use "
+            "(sync_sharding / sync_sharding_greedy with --num-ps >= 2); "
+            "other variants (and num_ps <= 1, which is pure DP) use "
             "different update programs and would silently ignore it"
         )
     conv_channels = args.conv_channels

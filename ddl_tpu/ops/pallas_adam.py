@@ -31,7 +31,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANES = 128  # TPU lane width: last dim of every VMEM tile
+from ..parallel.layout import LANE
+
+# TPU lane width: last dim of every VMEM tile. Shared with layout.max_shard's
+# alignment — the zero-copy reshape below relies on product shard slices
+# being rounded to this same width.
+LANES = LANE
 DEFAULT_BLOCK_ROWS = 512  # (512, 128) f32 tiles = 256 KiB per operand
 
 

@@ -138,6 +138,56 @@ def _block_counts(num_vars: int, num_shards: int) -> list[int]:
     return counts
 
 
+def _build(
+    policy: Policy,
+    order: list[str],
+    starts: list[int],
+    sz: list[int],
+    var_to_shard: dict[str, int] | None,
+    sizes: dict[str, int],
+) -> LayoutAssignment:
+    """Shared constructor tail: fill in the order-derived offsets."""
+    var_offsets = {}
+    off = 0
+    for n in order:
+        var_offsets[n] = off
+        off += sizes[n]
+    return LayoutAssignment(
+        policy=policy,
+        num_shards=len(sz),
+        order=tuple(order),
+        var_offsets=var_offsets,
+        shard_starts=tuple(starts),
+        shard_sizes=tuple(sz),
+        var_to_shard=var_to_shard,
+        total=sum(sizes[n] for n in order),
+    )
+
+
+def _var_granular(
+    policy: Policy,
+    order: list[str],
+    counts: list[int],
+    sizes: dict[str, int],
+) -> LayoutAssignment:
+    """Build a variable-aligned assignment from an ordered var list and
+    per-shard variable counts (``order`` grouped by shard, shard 0 first)."""
+    var_to_shard: dict[str, int] = {}
+    starts, sz = [], []
+    i = 0
+    offset = 0
+    for s, c in enumerate(counts):
+        starts.append(offset)
+        block = order[i : i + c]
+        for n in block:
+            var_to_shard[n] = s
+        size_s = sum(sizes[n] for n in block)
+        sz.append(size_s)
+        offset += size_s
+        i += c
+    return _build(policy, order, starts, sz, var_to_shard, sizes)
+
+
 def assign_layout(
     policy: Policy,
     num_shards: int,
@@ -150,57 +200,62 @@ def assign_layout(
     total = sum(sizes[n] for n in names)
 
     if policy == "flat":
-        order = list(names)
         # ceil then lane-align: equal padded shards whose boundaries match
         # the psum_scatter row split (collectives.reduce_scatter_flat with
         # chunk=max_shard).
         chunk = align_lane(-(-total // num_shards))
         starts = [min(s * chunk, total) for s in range(num_shards)]
         sz = [max(0, min(chunk, total - st)) for st in starts]
-        var_to_shard = None
+        return _build(policy, list(names), starts, sz, None, sizes)
+
+    if policy == "block":
+        order = block_order(names, sizes)
+        counts = _block_counts(len(names), num_shards)
+    elif policy == "zigzag":
+        order = zigzag_order(names, sizes)
+        counts = _block_counts(len(names), num_shards)
+    elif policy == "lpt":
+        order, counts = lpt_order(names, sizes, num_shards)
     else:
-        if policy == "block":
-            order = block_order(names, sizes)
-            counts = _block_counts(len(names), num_shards)
-        elif policy == "zigzag":
-            order = zigzag_order(names, sizes)
-            counts = _block_counts(len(names), num_shards)
-        elif policy == "lpt":
-            order, counts = lpt_order(names, sizes, num_shards)
-        else:
-            raise ValueError(f"unknown layout policy {policy!r}; want {POLICIES}")
-        if num_shards > len(names):
-            raise ValueError(
-                f"{policy!r} layout needs num_shards <= num_vars "
-                f"({num_shards} > {len(names)}); use policy='flat'"
-            )
-        var_to_shard = {}
-        starts, sz = [], []
-        i = 0
-        offset = 0
-        for s, c in enumerate(counts):
-            starts.append(offset)
-            block = order[i : i + c]
-            for n in block:
-                var_to_shard[n] = s
-            size_s = sum(sizes[n] for n in block)
-            sz.append(size_s)
-            offset += size_s
-            i += c
+        raise ValueError(f"unknown layout policy {policy!r}; want {POLICIES}")
+    if num_shards > len(names):
+        raise ValueError(
+            f"{policy!r} layout needs num_shards <= num_vars "
+            f"({num_shards} > {len(names)}); use policy='flat'"
+        )
+    return _var_granular(policy, order, counts, sizes)
 
-    var_offsets = {}
-    off = 0
-    for n in order:
-        var_offsets[n] = off
-        off += sizes[n]
 
-    return LayoutAssignment(
-        policy=policy,
-        num_shards=num_shards,
-        order=tuple(order),
-        var_offsets=var_offsets,
-        shard_starts=tuple(starts),
-        shard_sizes=tuple(sz),
-        var_to_shard=var_to_shard,
-        total=total,
-    )
+def fold_shards(
+    base: LayoutAssignment, num_devices: int, sizes: dict[str, int]
+) -> LayoutAssignment:
+    """Fold an S-shard variable-granular assignment onto fewer owner devices:
+    shard ``s`` lands on device ``s % num_devices``, keeping each shard's
+    variable grouping intact.
+
+    Reference parity: the launcher accepts ANY process split — ``run.sh 7 2``
+    runs 7 PS processes serving 2 workers, each PS owning a block of the
+    permuted variable list (mnist_sync_sharding/parameter_server.py:30-32).
+    On TPU the shards co-locate with the workers (ZeRO), so when the
+    requested shard count exceeds the mesh size the surplus shards wrap
+    round-robin onto the devices — the balancing the policy computed over S
+    bins is preserved per-bin, and the result is an ordinary
+    ``num_devices``-shard assignment the step programs consume unchanged.
+    ``flat`` never needs folding: re-splitting element-granular equal chunks
+    over ``num_devices`` produces the identical ownership.
+    """
+    S, W = base.num_shards, num_devices
+    if S <= W:
+        return base
+    if base.var_to_shard is None:
+        raise ValueError("fold_shards applies to variable-granular layouts; "
+                         "re-assign 'flat' over num_devices instead")
+    groups: list[list[str]] = [[] for _ in range(W)]
+    # base.order is grouped by shard in increasing shard index, so iterating
+    # it appends each device's shards in round-robin order (d, d+W, d+2W, …)
+    # with intra-shard order preserved.
+    for n in base.order:
+        groups[base.var_to_shard[n] % W].append(n)
+    order = [n for g in groups for n in g]
+    counts = [len(g) for g in groups]
+    return _var_granular(base.policy, order, counts, sizes)

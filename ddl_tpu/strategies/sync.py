@@ -50,7 +50,7 @@ from ..models import cnn
 from ..ops import AdamState, adam_init, adam_update
 from ..parallel import collectives as coll
 from ..parallel import multihost
-from ..parallel.layout import LayoutAssignment, assign_layout
+from ..parallel.layout import LayoutAssignment, assign_layout, fold_shards
 from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
 from ..train.trainer import (
@@ -348,17 +348,22 @@ def resolve_layout(
     means pure DP (no sharding); otherwise resolve the policy over the
     model's variable table (``sizes``; defaults to the flagship CNN). On TPU
     the shards co-locate with the workers (ZeRO) — there are no separate PS
-    processes, so ``num_ps`` means "number of devices that own a param
-    shard" and must be <= the mesh size."""
+    processes, so ``num_ps`` means "number of parameter shards". When
+    ``num_ps`` exceeds the mesh size (the reference's ``run.sh 7 2``: more
+    PS processes than workers), the surplus shards fold round-robin onto the
+    devices (layout.fold_shards) — any split the reference launcher accepts
+    runs here too."""
     if config.num_ps <= 1:
         return None
-    if config.num_ps > num_devices:
-        raise ValueError(
-            f"num_ps={config.num_ps} exceeds mesh size {num_devices}: TPU "
-            "shards co-locate with workers (ZeRO); use num_ps <= num_workers"
-        )
     if sizes is None:
         sizes = cnn.param_sizes()
+    if config.num_ps > num_devices:
+        if config.layout == "flat":
+            # Element-granular equal chunks: re-splitting over the mesh size
+            # is the identical ownership a fold would produce.
+            return assign_layout("flat", num_devices, list(sizes), sizes)
+        base = assign_layout(config.layout, config.num_ps, list(sizes), sizes)
+        return fold_shards(base, num_devices, sizes)
     # num_ps is honored for every policy; "flat" additionally unlocks the
     # fused psum_scatter fast path when num_ps == num_workers (full ZeRO-1).
     return assign_layout(config.layout, config.num_ps, list(sizes), sizes)

@@ -113,7 +113,12 @@ def replicated_result(async_inputs):
     return jax.tree.map(np.asarray, st), np.asarray(ps_rep)
 
 
-@pytest.mark.parametrize("policy,num_ps", [("block", 4), ("zigzag", 4), ("flat", 4)])
+@pytest.mark.parametrize(
+    "policy,num_ps",
+    # num_ps=14 > _W devices: reference any-split topology, shards folded
+    # round-robin onto the mesh (layout.fold_shards).
+    [("block", 4), ("zigzag", 4), ("flat", 4), ("block", 14)],
+)
 def test_sharded_serve_equals_replicated_serve(
     async_inputs, replicated_result, policy, num_ps
 ):

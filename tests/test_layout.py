@@ -125,3 +125,51 @@ def test_reassembly_index_roundtrip():
             padded[s, :sz] = flat[st : st + sz]
         idx = reassembly_index(a)
         np.testing.assert_array_equal(padded.reshape(-1)[idx], flat)
+
+
+def test_fold_shards_reference_any_split():
+    """num_ps > num_devices (the reference's ``run.sh 7 2`` — 7 PS over 2
+    workers, mnist_sync_sharding/parameter_server.py:30-32): surplus shards
+    fold round-robin, shard s -> device s % W, preserving each shard's
+    variable grouping."""
+    from ddl_tpu.parallel.layout import fold_shards
+
+    base = assign_layout("zigzag", 7, NAMES, SIZES)
+    folded = fold_shards(base, 2, SIZES)
+    assert folded.num_shards == 2
+    assert folded.policy == "zigzag"
+    # Partition invariants hold after folding.
+    assert sum(folded.shard_sizes) == folded.total == sum(SIZES.values())
+    assert sorted(folded.order) == sorted(NAMES)
+    # Ownership: exactly the round-robin fold of the base assignment.
+    for n in NAMES:
+        assert folded.var_to_shard[n] == base.var_to_shard[n] % 2
+    # Device 0's vars come from shards 0, 2, 4, 6 in that order.
+    d0 = [n for n in folded.order if folded.var_to_shard[n] == 0]
+    expected = [n for s in (0, 2, 4, 6) for n in base.order
+                if base.var_to_shard[n] == s]
+    assert d0 == expected
+
+
+def test_fold_shards_noop_when_enough_devices():
+    from ddl_tpu.parallel.layout import fold_shards
+
+    base = assign_layout("lpt", 4, NAMES, SIZES)
+    assert fold_shards(base, 8, SIZES) is base
+
+
+def test_resolve_layout_folds_surplus_shards():
+    """resolve_layout accepts any num_ps split like the reference launcher;
+    flat re-splits over the mesh, var-granular policies fold."""
+    from ddl_tpu.strategies.sync import resolve_layout
+    from ddl_tpu.train.config import TrainConfig
+
+    folded = resolve_layout(
+        TrainConfig(num_workers=2, num_ps=7, layout="block"), 2, SIZES
+    )
+    assert folded is not None and folded.num_shards == 2
+    flat = resolve_layout(
+        TrainConfig(num_workers=2, num_ps=7, layout="flat"), 2, SIZES
+    )
+    assert flat is not None and flat.num_shards == 2
+    assert flat.shard_sizes == assign_layout("flat", 2, NAMES, SIZES).shard_sizes

@@ -106,7 +106,10 @@ def test_dp_sum_compat_scales_update(batch, init):
 
 
 @pytest.mark.parametrize(
-    "policy,num_ps", [("flat", 8), ("block", 4), ("zigzag", 7), ("lpt", 8)]
+    "policy,num_ps",
+    # num_ps=14 > 8 devices: the reference's any-split topology
+    # (run.sh "14 8"); surplus shards fold round-robin (layout.fold_shards).
+    [("flat", 8), ("block", 4), ("zigzag", 7), ("lpt", 8), ("zigzag", 14)],
 )
 def test_sharded_matches_dp(batch, init, policy, num_ps):
     """ZeRO-1 sharded update ≡ replicated update for every layout policy —
