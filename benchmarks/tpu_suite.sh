@@ -1,0 +1,33 @@
+#!/bin/sh
+# One-shot TPU measurement suite: run everything BASELINE.md records from
+# the real chip, writing JSON into benchmarks/results/. Each tool writes to
+# a temp file moved into place only on success, so a failed re-run (e.g.
+# tunnel down — mesh.backend_ready fails fast) never clobbers good results,
+# and the first failure stops the suite with a nonzero exit.
+#
+#   sh benchmarks/tpu_suite.sh
+#
+# Rows produced:
+#   bench_tpu.json        headline sweep + sync W=1 (bench.py)
+#   adam_kernel_tpu.json  fused Pallas Adam vs XLA-fused chain
+#   tta_<variant>.json    time-to-target-accuracy, W=1 product trainers
+#                         (multi-worker variants are CPU-proxied in
+#                         scaling.json — one real chip here)
+set -ex
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+mkdir -p "$R"
+
+python bench.py >"$R/bench_tpu.json.tmp" 2>"$R/bench_tpu.log"
+mv "$R/bench_tpu.json.tmp" "$R/bench_tpu.json"
+
+python benchmarks/adam_kernel.py --json "$R/adam_kernel_tpu.json.tmp" \
+  2>"$R/adam_kernel_tpu.log"
+mv "$R/adam_kernel_tpu.json.tmp" "$R/adam_kernel_tpu.json"
+
+for v in single sync async; do
+  python benchmarks/time_to_accuracy.py --variant "$v" --workers 1 \
+    --target 0.99 --max-epochs 20 --bf16 \
+    --json "$R/tta_${v}.json.tmp" 2>"$R/tta_${v}.log"
+  mv "$R/tta_${v}.json.tmp" "$R/tta_${v}.json"
+done

@@ -263,7 +263,21 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
-            jax.config.update("jax_num_cpu_devices", max(args.num_workers or 8, 8))
+            if args.multihost and args.num_processes:
+                # Multi-process CPU world: the GLOBAL device count must be
+                # num_workers, spread evenly over the processes — a blanket
+                # 8 per process would put the whole mesh on process 0 and
+                # leave the others owning no rows (make_mesh rejects that).
+                W = args.num_workers or args.num_processes
+                if W % args.num_processes:
+                    raise SystemExit(
+                        f"--num-workers {W} is not divisible by "
+                        f"--num-processes {args.num_processes}"
+                    )
+                n_local = W // args.num_processes
+            else:
+                n_local = max(args.num_workers or 8, 8)
+            jax.config.update("jax_num_cpu_devices", n_local)
     if args.multihost:
         # Before any backend use: joining the world after the local backend
         # initializes would freeze a single-process device view.

@@ -37,6 +37,21 @@ def make_mesh(
                 f"requested {num_devices} devices, have {len(devices)}"
             )
         devices = devices[:num_devices]
+    if jax.process_count() > 1:
+        # Multi-controller world: a mesh that skips a process entirely
+        # leaves that process unable to build global arrays
+        # (make_array_from_process_local_data has no addressable shard) —
+        # surface it here instead of a StopIteration deep in staging.
+        missing = set(range(jax.process_count())) - {
+            d.process_index for d in devices
+        }
+        if missing:
+            raise ValueError(
+                f"mesh over {len(devices)} devices owns no row on "
+                f"process(es) {sorted(missing)}; use a worker count that "
+                "spans every process (e.g. --num-workers = the global "
+                "device count)"
+            )
     return Mesh(np.asarray(devices), (axis,))
 
 

@@ -115,3 +115,95 @@ print("EXPLICIT-WORLD-OK")
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "EXPLICIT-WORLD-OK" in proc.stdout
+
+
+def test_two_process_world_trains_end_to_end():
+    """REAL multi-controller training — two OS processes (the analogue of
+    the reference's mpiexec spanning nodes, mnist_sync/run.sh:3) join one
+    jax.distributed world (gloo over localhost), each owning ONE cpu device
+    of a 2-worker sync-DP mesh, feeding its own data shard, and training to
+    identical results. This is the multi-process path for real, not the
+    process-count=1 degenerate case."""
+    import os
+
+    port = multihost.free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    common = [
+        sys.executable, "-m", "ddl_tpu", "sync", "--multihost",
+        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+        "--platform", "cpu", "--num-workers", "2", "--tiny",
+        "--batch-size", "16", "--synthetic-train", "96",
+        "--synthetic-test", "64", "--eval-every", "3", "--json",
+    ]
+    procs = [
+        subprocess.Popen(
+            common + ["--process-id", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=280) for p in procs]
+    finally:
+        # A hung collective would otherwise leak both children (and the
+        # port) past the test and stall pytest shutdown.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"process failed:\n{err[-2000:]}"
+    payloads = []
+    for i, (out, _) in enumerate(outs):
+        assert f"multihost: process {i}/2, 2 global devices" in out
+        payloads.append(json.loads(out.strip().splitlines()[-1]))
+    # Same SPMD program, same global data -> both controllers report the
+    # identical result.
+    assert payloads[0]["final_accuracy"] == payloads[1]["final_accuracy"]
+    assert payloads[0]["step_stats"]["steps"] > 0
+    assert payloads[0]["config"]["num_workers"] == 2
+
+
+def test_mesh_skipping_a_process_is_rejected():
+    """A mesh whose rows all land on one process would strand the others
+    (no addressable shard to contribute); make_mesh must reject it with a
+    clear error instead of the deep StopIteration it used to surface."""
+    import os
+
+    port = multihost.free_port()
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+import sys
+from ddl_tpu.parallel import multihost
+from ddl_tpu.parallel.mesh import make_mesh
+multihost.initialize("127.0.0.1:{port}", num_processes=2,
+                     process_id=int(sys.argv[1]))
+try:
+    make_mesh(2)  # both rows on process 0
+except ValueError as e:
+    assert "owns no row" in str(e), e
+    print("MESH-GUARD-OK")
+multihost.shutdown()
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"process failed:\n{err[-2000:]}"
+        assert "MESH-GUARD-OK" in out
