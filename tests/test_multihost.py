@@ -14,6 +14,7 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddl_tpu.parallel import multihost
@@ -117,45 +118,60 @@ print("EXPLICIT-WORLD-OK")
     assert "EXPLICIT-WORLD-OK" in proc.stdout
 
 
-def test_two_process_world_trains_end_to_end():
-    """REAL multi-controller training — two OS processes (the analogue of
-    the reference's mpiexec spanning nodes, mnist_sync/run.sh:3) join one
-    jax.distributed world (gloo over localhost), each owning ONE cpu device
-    of a 2-worker sync-DP mesh, feeding its own data shard, and training to
-    identical results. This is the multi-process path for real, not the
-    process-count=1 degenerate case."""
+def _run_world(cmds: list[list[str]], timeout: float) -> list[str]:
+    """Launch one subprocess per command as a jax.distributed world, reap
+    them all, and return their stdouts. Kills survivors on any failure (a
+    hung collective would otherwise leak the children — and the coordinator
+    port — past the test and stall pytest shutdown). Children get a clean
+    platform env: the conftest CPU-mesh overrides must not leak in."""
     import os
 
-    port = multihost.free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    common = [
-        sys.executable, "-m", "ddl_tpu", "sync", "--multihost",
-        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
-        "--platform", "cpu", "--num-workers", "2", "--tiny",
-        "--batch-size", "16", "--synthetic-train", "96",
-        "--synthetic-test", "64", "--eval-every", "3", "--json",
-    ]
     procs = [
         subprocess.Popen(
-            common + ["--process-id", str(i)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
-        for i in (0, 1)
+        for cmd in cmds
     ]
     try:
-        outs = [p.communicate(timeout=280) for p in procs]
+        outs = [p.communicate(timeout=timeout) for p in procs]
     finally:
-        # A hung collective would otherwise leak both children (and the
-        # port) past the test and stall pytest shutdown.
         for p in procs:
             if p.poll() is None:
                 p.kill()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"process failed:\n{err[-2000:]}"
+    return [out for out, _ in outs]
+
+
+@pytest.mark.parametrize("variant,extra", [
+    ("sync", []),
+    # ZeRO-1 across processes: reduce-scatter / all-gather (and the shard
+    # state split) cross the process boundary over gloo.
+    ("sync_sharding", ["--num-ps", "2", "--layout", "flat"]),
+])
+def test_two_process_world_trains_end_to_end(variant, extra):
+    """REAL multi-controller training — two OS processes (the analogue of
+    the reference's mpiexec spanning nodes, mnist_sync/run.sh:3) join one
+    jax.distributed world (gloo over localhost), each owning ONE cpu device
+    of a 2-worker mesh, feeding its own data shard, and training to
+    identical results. This is the multi-process path for real, not the
+    process-count=1 degenerate case."""
+    port = multihost.free_port()
+    common = [
+        sys.executable, "-m", "ddl_tpu", variant, "--multihost",
+        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+        "--platform", "cpu", "--num-workers", "2", "--tiny",
+        "--batch-size", "16", "--synthetic-train", "96",
+        "--synthetic-test", "64", "--eval-every", "3", "--json",
+    ] + extra
+    outs = _run_world(
+        [common + ["--process-id", str(i)] for i in (0, 1)], timeout=280
+    )
     payloads = []
-    for i, (out, _) in enumerate(outs):
+    for i, out in enumerate(outs):
         assert f"multihost: process {i}/2, 2 global devices" in out
         payloads.append(json.loads(out.strip().splitlines()[-1]))
     # Same SPMD program, same global data -> both controllers report the
@@ -169,8 +185,6 @@ def test_mesh_skipping_a_process_is_rejected():
     """A mesh whose rows all land on one process would strand the others
     (no addressable shard to contribute); make_mesh must reject it with a
     clear error instead of the deep StopIteration it used to surface."""
-    import os
-
     port = multihost.free_port()
     code = f"""
 import jax
@@ -188,22 +202,8 @@ except ValueError as e:
     print("MESH-GUARD-OK")
 multihost.shutdown()
 """
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", code, str(i)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for i in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=120) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"process failed:\n{err[-2000:]}"
+    outs = _run_world(
+        [[sys.executable, "-c", code, str(i)] for i in (0, 1)], timeout=120
+    )
+    for out in outs:
         assert "MESH-GUARD-OK" in out
