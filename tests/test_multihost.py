@@ -151,6 +151,8 @@ def _run_world(cmds: list[list[str]], timeout: float) -> list[str]:
     # ZeRO-1 across processes: reduce-scatter / all-gather (and the shard
     # state split) cross the process boundary over gloo.
     ("sync_sharding", ["--num-ps", "2", "--layout", "flat"]),
+    # Sharded Hogwild serve: the two all_to_all exchanges cross processes.
+    ("async_sharding", ["--num-ps", "2"]),
 ])
 def test_two_process_world_trains_end_to_end(variant, extra):
     """REAL multi-controller training — two OS processes (the analogue of
