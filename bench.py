@@ -245,7 +245,7 @@ def main() -> None:
         os._exit(1)
     repeats = 3  # the tunnel is noisy; report best (capability) AND median
     sweep_best, sweep_median = {}, {}
-    for batch in (100, 200, 500, 1000):
+    for batch in (100, 200, 500, 1000, 2000):
         vals = bench_single(batch, repeats)
         sweep_best[batch] = round(max(vals), 1)
         sweep_median[batch] = round(statistics.median(vals), 1)

@@ -209,3 +209,17 @@ multihost.shutdown()
     )
     for out in outs:
         assert "MESH-GUARD-OK" in out
+
+
+def test_multihost_worker_count_must_split_over_processes():
+    """--num-workers not divisible by --num-processes on the CPU platform
+    fails fast (the per-process device count could not make the global
+    world equal the worker count)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ddl_tpu", "sync", "--multihost",
+         "--coordinator", "127.0.0.1:1", "--num-processes", "2",
+         "--process-id", "0", "--platform", "cpu", "--num-workers", "3"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "not divisible by" in proc.stderr
