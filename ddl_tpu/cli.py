@@ -330,11 +330,28 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    # Graceful preemption (preemptible TPU VMs send SIGTERM before
+    # reclaim): finish the in-flight span, save the rolling checkpoint,
+    # exit 0 — a later --resume run continues where this one stopped.
+    term = {"flag": False}
+    if args.checkpoint_dir:
+        import signal
+
+        def _on_term(signum, frame):
+            # Flag only — no IO in the handler (a print here can hit
+            # CPython's reentrant-BufferedWriter guard and kill the run
+            # uncheckpointed). Restoring SIG_DFL lets a second SIGTERM
+            # terminate promptly if the grace window is too short.
+            term["flag"] = True
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, _on_term)
     result = trainer.train(
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         profile_dir=args.profile,
+        should_stop=lambda: term["flag"],
     )
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
@@ -352,6 +369,7 @@ def main(argv: list[str] | None = None) -> int:
             "step_stats": dataclasses.asdict(result.step_stats)
                           if result.step_stats else None,
             "resumed_from_step": result.resumed_from_step,
+            "preempted": result.preempted,
         }))
     return 0
 

@@ -143,6 +143,23 @@ def put(mesh, pspec, host_array) -> Any:
     return jax.make_array_from_process_local_data(sharding, local)
 
 
+def agree_flag(flag: bool) -> bool:
+    """World-wide agreement (logical OR over processes) on a host-local
+    flag. Preemption must stop every controller at the SAME span: if one
+    process acts on its local SIGTERM while another dispatches the next
+    span's training collectives, the mismatched collectives deadlock the
+    world. Callers must invoke this from EVERY process at the same point
+    (it is itself a collective); at ``process_count() == 1`` it is a
+    no-op returning ``flag``."""
+    import jax
+
+    if jax.process_count() == 1:
+        return flag
+    from jax.experimental import multihost_utils
+
+    return bool(multihost_utils.process_allgather(np.int32(flag)).max())
+
+
 def replicate_for_host(mesh, tree) -> Any:
     """Make every leaf fully replicated — and therefore addressable from
     every process — before materializing to numpy (checkpoint saves, final

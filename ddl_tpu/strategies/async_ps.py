@@ -60,6 +60,7 @@ from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
 from ..train.trainer import (
     TrainResult,
+    check_preempt,
     checkpoint_file,
     evaluate,
     force,
@@ -418,6 +419,7 @@ class AsyncTrainer:
         checkpoint_every: int = 0,
         resume: bool = False,
         profile_dir: str | None = None,
+        should_stop: Callable[[], bool] | None = None,
     ) -> TrainResult:
         cfg = self.config
         W = cfg.num_workers
@@ -462,7 +464,7 @@ class AsyncTrainer:
                 ).compile()
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
-        stopped = False
+        stopped = preempted = False
         start = time.perf_counter()
         ps_full = None
         with trace(profile_dir):
@@ -490,9 +492,12 @@ class AsyncTrainer:
                         history.append((epoch, lo, acc))
                         log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)
+                    preempted = preempted or check_preempt(
+                        should_stop, log, ckpt is not None
+                    )
                     if ckpt and save_crossed(
                         ground, hi - lo, checkpoint_every,
-                        hi == rounds or stopped,
+                        hi == rounds or stopped or preempted,
                     ):
                         # Sharded PS state spans processes in a multi-host
                         # world; replicate so every process can materialize
@@ -503,10 +508,11 @@ class AsyncTrainer:
                                 self.mesh, state)},
                             step=epoch * rounds + hi, extra={"epoch": epoch},
                         )
-                    if stopped:
+                    if stopped or preempted:
                         break
                 if stopped:
                     log(f"target accuracy {cfg.target_accuracy} reached")
+                if stopped or preempted:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
@@ -526,4 +532,5 @@ class AsyncTrainer:
             compile_time_s=compile_time,
             step_stats=timer.stats(),
             resumed_from_step=start_round,
+            preempted=preempted,
         )

@@ -55,6 +55,7 @@ from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
 from ..train.config import TrainConfig
 from ..train.trainer import (
     TrainResult,
+    check_preempt,
     checkpoint_file,
     eval_spans,
     evaluate,
@@ -468,6 +469,7 @@ class SyncTrainer:
         checkpoint_every: int = 0,
         resume: bool = False,
         profile_dir: str | None = None,
+        should_stop: Callable[[], bool] | None = None,
     ) -> TrainResult:
         cfg = self.config
         ds = self.dataset
@@ -505,7 +507,7 @@ class SyncTrainer:
         }
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
-        stopped = False
+        stopped = preempted = False
         start = time.perf_counter()
         with trace(profile_dir):
             for epoch in range(cfg.epochs):
@@ -526,9 +528,12 @@ class SyncTrainer:
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)
+                    preempted = preempted or check_preempt(
+                        should_stop, log, ckpt is not None
+                    )
                     if ckpt and save_crossed(
                         gstep, k, checkpoint_every,
-                        first + k == batch_num or stopped,
+                        first + k == batch_num or stopped or preempted,
                     ):
                         # Sharded m/v span processes in a multi-host world;
                         # replicate so every process can materialize the
@@ -540,10 +545,11 @@ class SyncTrainer:
                                  self.mesh, opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
-                    if stopped:
+                    if stopped or preempted:
                         break
                 if stopped:
                     log(f"target accuracy {cfg.target_accuracy} reached")
+                if stopped or preempted:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
@@ -560,4 +566,5 @@ class SyncTrainer:
             compile_time_s=compile_time,
             step_stats=timer.stats(),
             resumed_from_step=start_step,
+            preempted=preempted,
         )

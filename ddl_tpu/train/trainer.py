@@ -25,6 +25,7 @@ import numpy as np
 from ..data import Dataset, one_hot
 from ..models import cnn
 from ..ops import AdamState, adam_init, adam_update
+from ..parallel import multihost
 from ..utils.checkpoint import load_checkpoint, save_checkpoint
 from ..utils.metrics import StepStats, StepTimer, trace
 from .config import TrainConfig
@@ -41,6 +42,7 @@ class TrainResult:
     compile_time_s: float = 0.0  # AOT compilation of the epoch programs
     step_stats: StepStats | None = None  # per-span dispatch-time percentiles
     resumed_from_step: int = 0  # global step restored from a checkpoint (0 = fresh)
+    preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
 
 
 def make_train_step(
@@ -199,6 +201,35 @@ def hit_target(config: TrainConfig, accuracy: float) -> bool:
     )
 
 
+def check_preempt(
+    should_stop: Callable[[], bool] | None,
+    log: Callable[[str], None],
+    has_checkpoint: bool,
+) -> bool:
+    """Graceful-preemption probe, polled once per dispatched span: when the
+    caller's ``should_stop`` (e.g. a CLI SIGTERM flag — preemptible TPU VMs
+    get a termination notice) flips true, the trainer saves its rolling
+    checkpoint and returns cleanly instead of dying mid-epoch. The
+    reference has no recovery story at all (SURVEY.md §5: any rank death
+    hangs the world forever).
+
+    Multi-process worlds: the local flag goes through
+    ``multihost.agree_flag`` so every controller stops at the SAME span —
+    SIGTERM delivery skew would otherwise leave one process saving (a
+    cross-host collective) while another dispatches the next span's
+    training collectives, deadlocking the world. Consequently
+    ``should_stop`` must be passed on every process or none."""
+    if should_stop is None:
+        return False
+    if not multihost.agree_flag(should_stop()):
+        return False
+    log("preempted: saving checkpoint and stopping after this span"
+        if has_checkpoint else
+        "preempted: stopping after this span (no checkpoint dir — "
+        "progress is NOT saved)")
+    return True
+
+
 def save_crossed(gstep: int, k: int, every: int, epoch_end: bool) -> bool:
     """Checkpoint cadence: save at every epoch end, plus whenever the span
     ``[gstep, gstep+k)`` crosses a multiple of ``every`` (0 = epoch-end
@@ -261,6 +292,7 @@ class SingleChipTrainer:
         checkpoint_every: int = 0,
         resume: bool = False,
         profile_dir: str | None = None,
+        should_stop: Callable[[], bool] | None = None,
     ) -> TrainResult:
         cfg = self.config
         batch_num = self.dataset.num_train // cfg.batch_size
@@ -310,7 +342,7 @@ class SingleChipTrainer:
         }
         compile_time = time.perf_counter() - t0
         timer = StepTimer()
-        stopped = False
+        stopped = preempted = False
         start = time.perf_counter()
         with trace(profile_dir):
             for epoch in range(cfg.epochs):
@@ -331,18 +363,22 @@ class SingleChipTrainer:
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)
+                    preempted = preempted or check_preempt(
+                        should_stop, log, ckpt is not None
+                    )
                     if ckpt and save_crossed(
                         gstep, k, checkpoint_every,
-                        first + k == batch_num or stopped,
+                        first + k == batch_num or stopped or preempted,
                     ):
                         save_checkpoint(
                             ckpt, {"params": params, "opt": opt_state},
                             step=gstep + k, extra={"epoch": epoch},
                         )
-                    if stopped:
+                    if stopped or preempted:
                         break
                 if stopped:
                     log(f"target accuracy {cfg.target_accuracy} reached")
+                if stopped or preempted:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
@@ -359,4 +395,5 @@ class SingleChipTrainer:
             compile_time_s=compile_time,
             step_stats=timer.stats(),
             resumed_from_step=start_step,
+            preempted=preempted,
         )

@@ -124,3 +124,64 @@ def test_profile_emits_trace(small_dataset, small_params, tmp_path):
     # Step stats ride along in every result.
     assert r.step_stats is not None and r.step_stats.steps > 0
     assert r.step_stats.images_per_sec > 0
+
+
+class StopAfter:
+    """should_stop callable flipping true at the Nth poll — the
+    deterministic stand-in for a SIGTERM flag (polled once per span)."""
+
+    def __init__(self, after: int):
+        self.after = after
+        self.polls = 0
+
+    def __call__(self) -> bool:
+        self.polls += 1
+        return self.polls >= self.after
+
+
+def test_preempted_run_saves_and_resumes(small_dataset, small_params, tmp_path):
+    """Graceful preemption: should_stop (the CLI's SIGTERM flag) stops the
+    run after the current span WITH a checkpoint, and a --resume run
+    finishes the job bit-identically to an uninterrupted one."""
+    cfg = TrainConfig(epochs=1, batch_size=256, eval_every=2, seed=5)
+    ref = SingleChipTrainer(cfg, small_dataset, init=small_params).train(
+        log=lambda s: None
+    )
+
+    d = str(tmp_path / "preempt")
+    # batch_num=8, spans (0,1)(1,2)(3,2)(5,2)(7,1): stop at the 3rd poll ->
+    # 5 of 8 batches done, mid-epoch.
+    pre = SingleChipTrainer(cfg, small_dataset, init=small_params).train(
+        log=lambda s: None, checkpoint_dir=d, should_stop=StopAfter(3)
+    )
+    assert pre.preempted
+    assert os.path.exists(os.path.join(d, "ckpt.npz"))
+
+    resumed = SingleChipTrainer(cfg, small_dataset, init=small_params).train(
+        log=lambda s: None, checkpoint_dir=d, resume=True
+    )
+    assert resumed.resumed_from_step == 5
+    assert not resumed.preempted
+    _assert_same_params(ref.params, resumed.params)
+    assert resumed.final_accuracy == ref.final_accuracy
+
+
+def test_preempted_sync_sharded_run_saves_and_resumes(
+    small_dataset, small_params, tmp_path
+):
+    kw = dict(num_workers=8, num_ps=4, layout="block", batch_size=256,
+              eval_every=2, seed=2)
+    ref = SyncTrainer(
+        TrainConfig(epochs=1, **kw), small_dataset, init=small_params
+    ).train(log=lambda s: None)
+
+    d = str(tmp_path / "sync-preempt")
+    pre = SyncTrainer(
+        TrainConfig(epochs=1, **kw), small_dataset, init=small_params
+    ).train(log=lambda s: None, checkpoint_dir=d, should_stop=StopAfter(3))
+    assert pre.preempted
+    resumed = SyncTrainer(
+        TrainConfig(epochs=1, **kw), small_dataset, init=small_params
+    ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
+    assert resumed.resumed_from_step == 5
+    _assert_same_params(ref.params, resumed.params)

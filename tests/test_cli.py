@@ -145,3 +145,43 @@ def test_cli_subprocess_smoke():
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload["variant"] == "sync_sharding_greedy"
     assert payload["config"]["layout"] == "zigzag"
+
+
+def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
+    """The real preemption path: SIGTERM to a running `python -m ddl_tpu`
+    makes it checkpoint, report preempted=true, and exit 0; a --resume
+    invocation finishes the job."""
+    import os
+    import signal as sig
+
+    d = str(tmp_path / "ck")
+    args = [sys.executable, "-m", "ddl_tpu", "single", "--platform", "cpu",
+            "--tiny", "--synthetic-train", "512", "--synthetic-test", "64",
+            "--batch-size", "64", "--eval-every", "2", "--epochs", "200",
+            "--checkpoint-dir", d, "--json"]
+    env = dict(os.environ, PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        # Wait for training to actually progress, then deliver SIGTERM.
+        for line in proc.stdout:
+            if line.startswith("epoch:"):
+                proc.send_signal(sig.SIGTERM)
+                break
+        out, err = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, err[-2000:]
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["preempted"] is True
+    assert os.path.exists(os.path.join(d, "ckpt.npz"))
+
+    resumed = subprocess.run(
+        args[:-1] + ["--resume", "--epochs", "1", "--json"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    rp = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert rp["preempted"] is False
+    assert rp["resumed_from_step"] > 0

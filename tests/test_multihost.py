@@ -223,3 +223,49 @@ def test_multihost_worker_count_must_split_over_processes():
     )
     assert proc.returncode != 0
     assert "not divisible by" in proc.stderr
+
+
+def test_preemption_agreement_across_processes(tmp_path):
+    """SIGTERM delivered to ONE process of a two-process world: the
+    preemption flag goes through multihost.agree_flag, so BOTH controllers
+    stop at the same span (mismatched stop points would deadlock the next
+    span's collectives), checkpoint, and exit 0."""
+    import os
+    import signal as sig
+
+    port = multihost.free_port()
+    d = str(tmp_path / "ck")
+    common = [
+        sys.executable, "-m", "ddl_tpu", "sync", "--multihost",
+        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+        "--platform", "cpu", "--num-workers", "2", "--tiny",
+        "--batch-size", "16", "--synthetic-train", "96",
+        "--synthetic-test", "64", "--eval-every", "2", "--epochs", "200",
+        "--checkpoint-dir", d, "--json",
+    ]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONUNBUFFERED"] = "1"
+    procs = [
+        subprocess.Popen(
+            common + ["--process-id", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in (0, 1)
+    ]
+    try:
+        for line in procs[0].stdout:
+            if line.startswith("epoch:"):
+                procs[0].send_signal(sig.SIGTERM)  # process 0 ONLY
+                break
+        outs = [p.communicate(timeout=280) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"process failed:\n{err[-2000:]}"
+        payload = json.loads(out.strip().splitlines()[-1])
+        assert payload["preempted"] is True  # both, though only p0 was signaled
+    assert os.path.exists(os.path.join(d, "ckpt.npz"))
