@@ -104,6 +104,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the checkpoint in --checkpoint-dir "
                         "(missing checkpoint starts fresh)")
+    p.add_argument("--dispatch-timeout", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="fail with a diagnosis (instead of hanging forever) "
+                        "if a training span or eval does not complete in "
+                        "SECONDS — accelerator-death detection; <= 0 "
+                        "disables")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the training loop "
                         "into DIR (view in TensorBoard/Perfetto)")
@@ -330,6 +336,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    from .parallel.mesh import AcceleratorTimeout
     # Graceful preemption (preemptible TPU VMs send SIGTERM before
     # reclaim): finish the in-flight span, save the rolling checkpoint,
     # exit 0 — a later --resume run continues where this one stopped.
@@ -346,13 +353,26 @@ def main(argv: list[str] | None = None) -> int:
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
         signal.signal(signal.SIGTERM, _on_term)
-    result = trainer.train(
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        resume=args.resume,
-        profile_dir=args.profile,
-        should_stop=lambda: term["flag"],
-    )
+    try:
+        result = trainer.train(
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            profile_dir=args.profile,
+            should_stop=lambda: term["flag"],
+            dispatch_timeout=args.dispatch_timeout,
+        )
+    except AcceleratorTimeout as e:
+        # The watchdogged fetch is still wedged in native code; a normal
+        # exit would re-enter the dead backend via atexit/PJRT destructors
+        # and hang anyway — report, flush, and leave (the AcceleratorTimeout
+        # contract, parallel/mesh.py).
+        print(f"[ddl_tpu] FATAL: {e}", file=sys.stderr)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        import os
+
+        os._exit(1)
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")

@@ -95,25 +95,62 @@ def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+class AcceleratorTimeout(RuntimeError):
+    """A watchdogged native call did not complete: the accelerator backend
+    is presumed dead/unreachable. The wedged thread is STILL blocked in
+    native code — after reporting, the process should exit via ``os._exit``
+    (normal interpreter shutdown can re-enter the dead backend through
+    atexit/PJRT destructors and hang anyway)."""
+
+
+def run_within(fn, timeout_s: float, *, what: str = "operation"):
+    """Run ``fn`` on a daemon watchdog thread; return its result, re-raise
+    its exception, or raise :class:`AcceleratorTimeout` after ``timeout_s``
+    seconds. The one shared wedged-native-call watchdog (backend probes,
+    training-span fetches): native backend calls can block INDEFINITELY
+    when the accelerator dies (the axon tunnel drops for hours) and cannot
+    be interrupted — only abandoned. See :class:`AcceleratorTimeout` for
+    the post-timeout exit contract."""
+    import threading
+
+    outcome: list[tuple[bool, object]] = []
+
+    def run():
+        try:
+            outcome.append((True, fn()))
+        except BaseException as e:  # surface the real error, not a timeout
+            outcome.append((False, e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not outcome:
+        raise AcceleratorTimeout(
+            f"{what} did not complete within {timeout_s:.0f}s"
+        )
+    ok, value = outcome[0]
+    if not ok:
+        raise value
+    return value
+
+
 def backend_ready(timeout_s: float = 240.0) -> bool:
     """Probe the default backend with a watchdog thread. The axon tunnel's
     remote handshake can block INDEFINITELY when the tunnel is down; a
     benchmark that hangs forever is worse than one that reports the outage.
     NB when this returns False the probe thread is stuck in native code —
     callers must exit via ``os._exit`` (after flushing stdout)."""
-    import threading
-
-    ok: list[int] = []
 
     def probe():
         import jax
 
-        ok.append(len(jax.devices()))
+        return len(jax.devices())
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return bool(ok)
+    try:
+        run_within(probe, timeout_s, what="backend probe")
+        return True
+    except AcceleratorTimeout:
+        return False
 
 
 def donation_for(mesh: Mesh, *argnums: int) -> tuple[int, ...]:

@@ -64,6 +64,8 @@ from ..train.trainer import (
     checkpoint_file,
     evaluate,
     force,
+    force_within,
+    guarded,
     hit_target,
     save_crossed,
     try_resume,
@@ -420,6 +422,7 @@ class AsyncTrainer:
         resume: bool = False,
         profile_dir: str | None = None,
         should_stop: Callable[[], bool] | None = None,
+        dispatch_timeout: float = 0.0,
     ) -> TrainResult:
         cfg = self.config
         W = cfg.num_workers
@@ -485,10 +488,17 @@ class AsyncTrainer:
                         state, ps_full, _ = compiled[hi - lo](
                             state, xs_dev[lo:hi], ys_dev[lo:hi], rngs, sched
                         )
-                        force(ps_full)  # barrier: the compiled[...] round dispatch
+                        # barrier: the compiled[...] round dispatch
+                        force_within(
+                            ps_full, dispatch_timeout,
+                            f"round dispatch at global round {ground}",
+                        )
                     if cfg.eval_every:
                         params = self._unflatten(ps_full)
-                        acc = evaluate(params, x_test, y_test)
+                        acc = guarded(
+                            lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, f"eval after round {lo}",
+                        )
                         history.append((epoch, lo, acc))
                         log(f"epoch: {epoch} round: {lo} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)

@@ -60,6 +60,8 @@ from ..train.trainer import (
     eval_spans,
     evaluate,
     force,
+    force_within,
+    guarded,
     hit_target,
     save_crossed,
     try_resume,
@@ -470,6 +472,7 @@ class SyncTrainer:
         resume: bool = False,
         profile_dir: str | None = None,
         should_stop: Callable[[], bool] | None = None,
+        dispatch_timeout: float = 0.0,
     ) -> TrainResult:
         cfg = self.config
         ds = self.dataset
@@ -521,10 +524,17 @@ class SyncTrainer:
                             jnp.int32(first), jnp.int32(gstep),
                             self.dropout_key,
                         )
-                        force(params)  # barrier: the fns[k] span dispatch
+                        # barrier: the fns[k] span dispatch
+                        force_within(
+                            params, dispatch_timeout,
+                            f"span dispatch at global step {gstep}",
+                        )
                     if eval_after:
                         cnt = first + k - 1
-                        acc = evaluate(params, x_test, y_test)
+                        acc = guarded(
+                            lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, f"eval after batch {cnt}",
+                        )
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)

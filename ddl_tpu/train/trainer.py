@@ -26,6 +26,7 @@ from ..data import Dataset, one_hot
 from ..models import cnn
 from ..ops import AdamState, adam_init, adam_update
 from ..parallel import multihost
+from ..parallel.mesh import AcceleratorTimeout, run_within
 from ..utils.checkpoint import load_checkpoint, save_checkpoint
 from ..utils.metrics import StepStats, StepTimer, trace
 from .config import TrainConfig
@@ -100,6 +101,31 @@ def force(tree, *, all_leaves: bool = False) -> None:
     for s in scalars:
         np.asarray(s)
     jax.block_until_ready(leaves)
+
+
+def guarded(fn, timeout_s: float, what: str):
+    """Run ``fn`` under the accelerator watchdog (``mesh.run_within``) —
+    failure detection for the accelerator itself. A dead backend mid-run
+    (this bench host's TPU tunnel drops for hours at a time) leaves host
+    fetches blocked in native code FOREVER, the same failure mode as the
+    reference's rank-death hang (SURVEY.md §5: any dead rank blocks
+    Recv/Bcast indefinitely). A timeout is annotated with the recovery
+    route; ``timeout_s <= 0`` disables (plain call, no thread)."""
+    if timeout_s <= 0:
+        return fn()
+    try:
+        return run_within(fn, timeout_s, what=what)
+    except AcceleratorTimeout as e:
+        raise AcceleratorTimeout(
+            f"{e} — accelerator backend presumed unreachable (e.g. TPU "
+            "tunnel outage). Training state up to the last checkpoint is "
+            "safe; rerun with --resume once the backend is back."
+        ) from None
+
+
+def force_within(tree, timeout_s: float, what: str) -> None:
+    """Watchdogged ``force`` (see :func:`guarded`)."""
+    return guarded(lambda: force(tree), timeout_s, what)
 
 
 def eval_spans(batch_num: int, eval_every: int) -> list[tuple[int, int, bool]]:
@@ -293,6 +319,7 @@ class SingleChipTrainer:
         resume: bool = False,
         profile_dir: str | None = None,
         should_stop: Callable[[], bool] | None = None,
+        dispatch_timeout: float = 0.0,
     ) -> TrainResult:
         cfg = self.config
         batch_num = self.dataset.num_train // cfg.batch_size
@@ -356,10 +383,17 @@ class SingleChipTrainer:
                             jnp.int32(first), jnp.int32(gstep),
                             self.dropout_key,
                         )
-                        force(params)  # barrier: the fns[k] span dispatch
+                        # barrier: the fns[k] span dispatch
+                        force_within(
+                            params, dispatch_timeout,
+                            f"span dispatch at global step {gstep}",
+                        )
                     if eval_after:
                         cnt = first + k - 1
-                        acc = evaluate(params, x_test, y_test)
+                        acc = guarded(
+                            lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, f"eval after batch {cnt}",
+                        )
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)

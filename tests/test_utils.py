@@ -56,3 +56,47 @@ def test_step_timer():
     s = t.stats()
     assert s.steps == 3
     assert s.images_per_sec > 0
+
+
+def test_force_within_passes_normal_and_raises_on_hang():
+    """Accelerator-death detection (force_within): a completing fetch is
+    transparent, a genuinely wedged one raises with the --resume recovery
+    route, and an error inside the fetch surfaces as itself (never masked
+    by the timeout message)."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import pytest
+
+    from ddl_tpu.train import trainer as tr
+
+    # Normal path: completes, no error (timeout generous).
+    tr.force_within(jnp.arange(4.0), 30.0, "test fetch")
+
+    # Hang path: monkeypatch-free — a tree whose leaf access blocks.
+    class Wedged:
+        ndim, size = 1, 1
+
+        def __getitem__(self, idx):
+            _time.sleep(60)
+
+    from ddl_tpu.parallel.mesh import AcceleratorTimeout
+
+    with pytest.raises(AcceleratorTimeout, match="--resume"):
+        tr.force_within(Wedged(), 0.2, "wedged fetch")
+
+    # <= 0 disables the watchdog entirely (negative is NOT an instant
+    # timeout): the wedged fetch is simply not guarded... so use a real
+    # tree to prove the call goes straight through.
+    tr.force_within(jnp.arange(4.0), -1.0, "unguarded fetch")
+    assert tr.guarded(lambda: 7, 0.0, "plain call") == 7
+
+    # Error path: the real exception propagates, not the timeout wording.
+    class Broken:
+        ndim, size = 1, 1
+
+        def __getitem__(self, idx):
+            raise ValueError("device exploded")
+
+    with pytest.raises(ValueError, match="device exploded"):
+        tr.force_within(Broken(), 30.0, "broken fetch")
