@@ -127,3 +127,23 @@ def reassembly_index(layout: LayoutAssignment) -> np.ndarray:
     for s, (start, size) in enumerate(zip(layout.shard_starts, layout.shard_sizes)):
         idx[start : start + size] = s * m + np.arange(size, dtype=np.int32)
     return idx
+
+
+def to_logical(padded_flat, layout: LayoutAssignment) -> np.ndarray:
+    """Per-shard padded concatenation ``[>= S * max_shard]`` -> logical flat
+    ``[total]`` in THIS layout's variable order (``layout.order``). NB the
+    order is layout-specific — for a layout-independent form (e.g. the
+    elastic checkpoint), unflatten the result into the params-shaped pytree
+    with :func:`unflatten_params`."""
+    return np.asarray(padded_flat)[reassembly_index(layout)]
+
+
+def from_logical(logical, layout: LayoutAssignment, n: int) -> np.ndarray:
+    """Inverse of :func:`to_logical`: scatter a logical flat vector (in
+    THIS layout's order) into an ``[n]`` per-shard padded concatenation
+    (``n = mesh_size * layout.max_shard``; padding stays zero, matching
+    ``sharded_adam_init``)."""
+    logical = np.asarray(logical)
+    out = np.zeros(n, logical.dtype)
+    out[reassembly_index(layout)] = logical
+    return out

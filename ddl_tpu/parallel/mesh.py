@@ -149,7 +149,10 @@ def backend_ready(timeout_s: float = 240.0) -> bool:
     try:
         run_within(probe, timeout_s, what="backend probe")
         return True
-    except AcceleratorTimeout:
+    except Exception:
+        # Timeout OR fast failure (e.g. 'unable to initialize backend'):
+        # either way the backend is not ready — callers print their error
+        # JSON instead of crashing with a traceback.
         return False
 
 

@@ -399,9 +399,9 @@ class AsyncTrainer:
             return state.ps
         # Host gather of [W * chunk]; replicate first so the shards are
         # addressable from every process (no-op at one process).
-        flat = np.asarray(multihost.replicate_for_host(self.mesh, state.ps))
+        flat = multihost.replicate_for_host(self.mesh, state.ps)
         return multihost.put(
-            self.mesh, P(), flat[coll.reassembly_index(self.layout)]
+            self.mesh, P(), coll.to_logical(flat, self.layout)
         )
 
     def _place_state(self, state: AsyncState) -> AsyncState:
@@ -445,7 +445,8 @@ class AsyncTrainer:
         # and keeps the sharding).
         xs_dev = multihost.put(self.mesh, data_spec, xs_all)
         ys_dev = multihost.put(self.mesh, data_spec, ys_all)
-        force((xs_dev, ys_dev, state), all_leaves=True)
+        guarded(lambda: force((xs_dev, ys_dev, state), all_leaves=True),
+                dispatch_timeout, "train-set staging")
         history: list[tuple[int, int, float]] = []
         chunk_rounds = cfg.eval_every if cfg.eval_every else rounds
         images_per_round = cfg.batch_size * W  # W pushes of one batch each
@@ -529,7 +530,8 @@ class AsyncTrainer:
         if ps_full is None:  # fully-resumed run: nothing left to execute
             ps_full = self._gather_ps(state)
         params = self._unflatten(ps_full)
-        final_acc = evaluate(params, x_test, y_test)
+        final_acc = guarded(lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, "final eval")
         log(f"final accuracy: {final_acc}")
         self.state = state
         return TrainResult(

@@ -448,18 +448,65 @@ class SyncTrainer:
         return (multihost.put(self.mesh, spec, xs),
                 multihost.put(self.mesh, spec, ys))
 
+    def _ckpt_spec(self) -> coll.FlatSpec:
+        return coll.FlatSpec.from_layout(self.layout, self._shapes)
+
+    def _opt_like(self):
+        """Host-shaped template for the checkpointed optimizer state:
+        replicated Adam as-is (DP); ZeRO-1 m/v as PARAMS-SHAPED pytrees —
+        the layout-independent form, so a checkpoint written at one
+        topology resumes at any other (elastic resume: a preempted 8-chip
+        flat run can continue as a 4-chip zigzag run). A flat vector would
+        NOT be elastic — each layout orders variables differently."""
+        if self.layout is None:
+            return self.opt_state
+        zeros = {n: np.zeros(s, np.float32) for n, s in self._shapes.items()}
+        return ShardedAdam(
+            step=np.zeros((), np.int32),
+            m=zeros,
+            v={n: z.copy() for n, z in zeros.items()},
+        )
+
+    def _opt_for_save(self, opt_state):
+        """Checkpoint form of the optimizer state (see ``_opt_like``).
+        Sharded m/v span processes in a multi-host world; replicate first
+        so every process can materialize the save (no-op at one process)."""
+        if self.layout is None:
+            return multihost.replicate_for_host(self.mesh, opt_state)
+        rep = multihost.replicate_for_host(
+            self.mesh, (opt_state.m, opt_state.v)
+        )
+        spec = self._ckpt_spec()
+        unflat = lambda padded: jax.tree.map(np.asarray, coll.unflatten_params(
+            jnp.asarray(coll.to_logical(padded, self.layout)), spec
+        ))
+        return ShardedAdam(
+            step=np.asarray(opt_state.step),
+            m=unflat(rep[0]),
+            v=unflat(rep[1]),
+        )
+
     def _place_state(self, params, opt_state):
         """Re-place host (checkpoint) state onto this trainer's shardings:
-        params replicated; Adam state replicated (DP) or m/v mesh-sharded
-        (ZeRO-1)."""
+        params replicated; Adam state replicated (DP) or params-shaped m/v
+        re-flattened and re-sharded onto the CURRENT mesh/layout (ZeRO-1,
+        elastic)."""
         params = multihost.put_tree(self.mesh, P(), params)
         if self.layout is None:
             opt_state = multihost.put_tree(self.mesh, P(), opt_state)
         else:
+            spec = self._ckpt_spec()
+            n = self.mesh.devices.size * self.layout.max_shard
+            refit = lambda tree: multihost.put(
+                self.mesh, P(DP_AXIS), coll.from_logical(
+                    np.asarray(coll.flatten_params(tree, spec)),
+                    self.layout, n,
+                ),
+            )
             opt_state = ShardedAdam(
-                step=multihost.put(self.mesh, P(), opt_state.step),
-                m=multihost.put(self.mesh, P(DP_AXIS), opt_state.m),
-                v=multihost.put(self.mesh, P(DP_AXIS), opt_state.v),
+                step=multihost.put(self.mesh, P(), np.asarray(opt_state.step)),
+                m=refit(opt_state.m),
+                v=refit(opt_state.v),
             )
         return params, opt_state
 
@@ -489,14 +536,15 @@ class SyncTrainer:
         opt_state = jax.tree.map(jnp.copy, self.opt_state)
         ckpt = checkpoint_file(checkpoint_dir)
         tree, start_step = try_resume(
-            ckpt, resume, {"params": params, "opt": opt_state}, log
+            ckpt, resume, {"params": params, "opt": self._opt_like()}, log
         )
         if tree is not None:
             params, opt_state = self._place_state(tree["params"], tree["opt"])
         # Materialize staged data + state BEFORE the clock starts: transfers
         # are async (and lazy on the tunnel backend); steady-state throughput
         # must not absorb the host->HBM upload of the train set.
-        force((xs, ys, params, opt_state), all_leaves=True)
+        guarded(lambda: force((xs, ys, params, opt_state), all_leaves=True),
+                dispatch_timeout, "train-set staging")
         spans = eval_spans(batch_num, cfg.eval_every)
         history: list[tuple[int, int, float]] = []
         # AOT-compile every span program outside the timed region (first TPU
@@ -545,14 +593,10 @@ class SyncTrainer:
                         gstep, k, checkpoint_every,
                         first + k == batch_num or stopped or preempted,
                     ):
-                        # Sharded m/v span processes in a multi-host world;
-                        # replicate so every process can materialize the
-                        # save (no-op at one process).
                         save_checkpoint(
                             ckpt,
                             {"params": params,
-                             "opt": multihost.replicate_for_host(
-                                 self.mesh, opt_state)},
+                             "opt": self._opt_for_save(opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
                     if stopped or preempted:
@@ -563,7 +607,8 @@ class SyncTrainer:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
-        final_acc = evaluate(params, x_test, y_test)
+        final_acc = guarded(lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, "final eval")
         log(f"final accuracy: {final_acc}")
         self.params, self.opt_state = params, opt_state
         return TrainResult(

@@ -212,7 +212,15 @@ def try_resume(
     if not os.path.exists(ckpt_path):
         log(f"[resume] no checkpoint at {ckpt_path}; starting fresh")
         return None, 0
-    tree, step, _extra = load_checkpoint(ckpt_path, like)
+    try:
+        tree, step, _extra = load_checkpoint(ckpt_path, like)
+    except (KeyError, ValueError) as e:
+        raise RuntimeError(
+            f"checkpoint {ckpt_path} is incompatible with this trainer's "
+            f"state (different strategy family, model width, or an older "
+            f"checkpoint format): {e}. Delete the checkpoint to start "
+            "fresh, or resume with the original configuration."
+        ) from e
     step = int(step or 0)
     log(f"[resume] restored global step {step} from {ckpt_path}")
     return tree, step
@@ -355,7 +363,8 @@ class SingleChipTrainer:
         # Materialize staged data + state BEFORE the clock starts: transfers
         # are async (and lazy on the tunnel backend); steady-state throughput
         # must not absorb the host->HBM upload of the train set.
-        force((xs, ys, params, opt_state), all_leaves=True)
+        guarded(lambda: force((xs, ys, params, opt_state), all_leaves=True),
+                dispatch_timeout, "train-set staging")
         history: list[tuple[int, int, float]] = []
         spans = eval_spans(batch_num, cfg.eval_every)
         # AOT-compile every span program outside the timed region (first TPU
@@ -416,7 +425,8 @@ class SingleChipTrainer:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
-        final_acc = evaluate(params, x_test, y_test)
+        final_acc = guarded(lambda: evaluate(params, x_test, y_test),
+                            dispatch_timeout, "final eval")
         log(f"final accuracy: {final_acc}")
         self.params, self.opt_state = params, opt_state
         return TrainResult(

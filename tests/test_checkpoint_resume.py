@@ -185,3 +185,73 @@ def test_preempted_sync_sharded_run_saves_and_resumes(
     ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
     assert resumed.resumed_from_step == 5
     _assert_same_params(ref.params, resumed.params)
+
+
+def test_elastic_resume_across_topologies(small_dataset, small_params, tmp_path):
+    """ZeRO-1 optimizer state is checkpointed in LOGICAL (layout-free)
+    order, so a run preempted at one topology resumes at another: epoch 1
+    on 8 workers / 8 flat shards, epoch 2 on 4 workers / 3 zigzag shards.
+    keep_prob=1 + mean reduction make every sync topology step-equivalent,
+    so the stitched run must match a single-chip 2-epoch oracle."""
+    base = dict(batch_size=256, eval_every=0, keep_prob=1.0, seed=2)
+    ref = SingleChipTrainer(
+        TrainConfig(epochs=2, **base), small_dataset, init=small_params
+    ).train(log=lambda s: None)
+
+    d = str(tmp_path / "elastic")
+    SyncTrainer(
+        TrainConfig(epochs=1, num_workers=8, num_ps=8, layout="flat", **base),
+        small_dataset, init=small_params,
+    ).train(log=lambda s: None, checkpoint_dir=d)
+    resumed = SyncTrainer(
+        TrainConfig(epochs=2, num_workers=4, num_ps=3, layout="zigzag", **base),
+        small_dataset, init=small_params,
+    ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
+    assert resumed.resumed_from_step == 8  # batch_num = 2048/256
+    for k in ref.params:
+        np.testing.assert_allclose(
+            ref.params[k], resumed.params[k], atol=2e-5, err_msg=k
+        )
+
+
+def test_cross_strategy_resume_single_to_sharded(
+    small_dataset, small_params, tmp_path
+):
+    """The elastic checkpoint format (params-shaped m/v) is shared by the
+    replicated AdamState and ZeRO-1 ShardedAdam, so resume even crosses
+    strategy families: epoch 1 on the single-chip trainer, epoch 2 on the
+    8-worker sharded sync trainer, matching the uninterrupted oracle."""
+    base = dict(batch_size=256, eval_every=0, keep_prob=1.0, seed=2)
+    ref = SingleChipTrainer(
+        TrainConfig(epochs=2, **base), small_dataset, init=small_params
+    ).train(log=lambda s: None)
+
+    d = str(tmp_path / "cross")
+    SingleChipTrainer(
+        TrainConfig(epochs=1, **base), small_dataset, init=small_params
+    ).train(log=lambda s: None, checkpoint_dir=d)
+    resumed = SyncTrainer(
+        TrainConfig(epochs=2, num_workers=8, num_ps=4, layout="flat", **base),
+        small_dataset, init=small_params,
+    ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
+    assert resumed.resumed_from_step == 8
+    for k in ref.params:
+        np.testing.assert_allclose(
+            ref.params[k], resumed.params[k], atol=2e-5, err_msg=k
+        )
+
+
+def test_incompatible_checkpoint_is_diagnosed(small_dataset, small_params, tmp_path):
+    """Resuming a checkpoint into a DIFFERENT model width fails with a
+    diagnosed RuntimeError, not a raw shape ValueError."""
+    base = dict(batch_size=512, eval_every=0, seed=0)
+    d = str(tmp_path / "mismatch")
+    SingleChipTrainer(
+        TrainConfig(epochs=1, **base), small_dataset, init=small_params
+    ).train(log=lambda s: None, checkpoint_dir=d)
+    with pytest.raises(RuntimeError, match="incompatible"):
+        SingleChipTrainer(
+            TrainConfig(epochs=1, conv_channels=(2, 4, 4, 4),
+                        fc_sizes=(16, 8), **base),
+            small_dataset,
+        ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
