@@ -225,7 +225,13 @@ def test_multihost_worker_count_must_split_over_processes():
     assert "not divisible by" in proc.stderr
 
 
-def test_preemption_agreement_across_processes(tmp_path):
+@pytest.mark.parametrize("variant,extra", [
+    ("sync", []),
+    # Sharded: the preemption save exercises the cross-process
+    # replicate_for_host + logical-order conversion of ZeRO-1 m/v.
+    ("sync_sharding", ["--num-ps", "2", "--layout", "flat"]),
+])
+def test_preemption_agreement_across_processes(tmp_path, variant, extra):
     """SIGTERM delivered to ONE process of a two-process world: the
     preemption flag goes through multihost.agree_flag, so BOTH controllers
     stop at the same span (mismatched stop points would deadlock the next
@@ -236,13 +242,13 @@ def test_preemption_agreement_across_processes(tmp_path):
     port = multihost.free_port()
     d = str(tmp_path / "ck")
     common = [
-        sys.executable, "-m", "ddl_tpu", "sync", "--multihost",
+        sys.executable, "-m", "ddl_tpu", variant, "--multihost",
         "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
         "--platform", "cpu", "--num-workers", "2", "--tiny",
         "--batch-size", "16", "--synthetic-train", "96",
         "--synthetic-test", "64", "--eval-every", "2", "--epochs", "200",
         "--checkpoint-dir", d, "--json",
-    ]
+    ] + extra
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONUNBUFFERED"] = "1"
