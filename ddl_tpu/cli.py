@@ -383,6 +383,10 @@ def main(argv: list[str] | None = None) -> int:
             "variant": args.variant,
             "config": dataclasses.asdict(cfg),
             "final_accuracy": result.final_accuracy,
+            # (epoch, batch/round, accuracy) per eval point — the
+            # machine-readable form of the reference's accuracy prints
+            # (mnist_sync/worker.py:71-72).
+            "history": [[e, b, round(a, 6)] for e, b, a in result.history],
             "train_time_s": result.train_time_s,
             "images_per_sec": result.images_per_sec,
             "compile_time_s": result.compile_time_s,
