@@ -173,3 +173,32 @@ def test_resolve_layout_folds_surplus_shards():
     )
     assert flat is not None and flat.num_shards == 2
     assert flat.shard_sizes == assign_layout("flat", 2, NAMES, SIZES).shard_sizes
+
+
+def test_fold_shards_invariants_random_sweep():
+    """Partition invariants hold for arbitrary variable tables and any
+    (policy, num_shards, num_devices) combination — the fold is pure
+    (name, size) math, so sweep it broadly."""
+    from ddl_tpu.parallel.layout import fold_shards
+
+    rng = np.random.default_rng(7)
+    for trial in range(25):
+        n_vars = int(rng.integers(2, 20))
+        names = [f"t{i}" for i in range(n_vars)]
+        sizes = {n: int(rng.integers(1, 5000)) for n in names}
+        policy = ["block", "zigzag", "lpt"][trial % 3]
+        S = int(rng.integers(1, n_vars + 1))
+        W = int(rng.integers(1, 9))
+        base = assign_layout(policy, S, names, sizes)
+        folded = fold_shards(base, W, sizes)
+        assert folded.num_shards == min(S, W)
+        assert sum(folded.shard_sizes) == folded.total == sum(sizes.values())
+        assert sorted(folded.order) == sorted(names)
+        if S > W:
+            for n in names:
+                assert folded.var_to_shard[n] == base.var_to_shard[n] % W
+        # Contiguous disjoint shard ranges.
+        off = 0
+        for st, sz in zip(folded.shard_starts, folded.shard_sizes):
+            assert st == off
+            off += sz
