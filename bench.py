@@ -226,22 +226,30 @@ def bench_torch_cpu(steps: int = 8, batch: int = 200) -> float:
 
 
 def main() -> None:
-    from ddl_tpu.parallel.mesh import backend_ready
+    import os
 
-    if not backend_ready():
+    from ddl_tpu.parallel.mesh import wait_backend
+
+    # Bounded retry window (default 45 min, probe every 3 min): the shared
+    # TPU tunnel drops for minutes-to-hours at a time, and a single-probe
+    # exit nulled round 3's driver bench (BENCH_r03.json rc=1). Probes run
+    # in throwaway subprocesses so a wedged native handshake can be
+    # retried; this process only touches JAX after a probe succeeds.
+    window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 2700))
+    if not wait_backend(
+        window_s, log=lambda m: print(f"[bench] {m}", file=sys.stderr)
+    ):
         print(json.dumps({
             "metric": "mnist_sync_images_per_sec_per_chip",
             "value": None,
             "unit": "images/s",
             "vs_baseline": None,
-            "error": "default JAX backend unreachable (TPU tunnel down?) — "
-                     "no measurement taken; see BASELINE.md for the last "
-                     "recorded numbers",
+            "error": "default JAX backend unreachable (TPU tunnel down?) "
+                     f"after retrying for {window_s:.0f}s — no measurement "
+                     "taken; see BASELINE.md for the last recorded numbers",
         }), flush=True)
-        # The probe thread is stuck in native code; a normal exit would
-        # join it forever (flush above — _exit skips stdio cleanup).
-        import os
-
+        # Subprocess probes leave this process clean, but never initialize
+        # the backend here just to exit; _exit skips any atexit PJRT hooks.
         os._exit(1)
     repeats = 3  # the tunnel is noisy; report best (capability) AND median
     sweep_best, sweep_median = {}, {}

