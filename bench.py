@@ -76,13 +76,14 @@ def train_step_flops_per_image() -> float:
 
 def _staged_epoch(batch: int, chunk_steps: int):
     """Device-resident [B, bs, 784] / [B, bs, 10] batches, B = chunk_steps —
-    the same layout SingleChipTrainer stages (trainer.py _chunk staging)."""
+    the same layout SingleChipTrainer stages, including bf16 image staging
+    (trainer.staging_dtype — the bench configs are all bf16)."""
     import jax.numpy as jnp
 
     from ddl_tpu.data import one_hot, synthesize
 
     x, y = synthesize(chunk_steps * batch, seed=0)
-    xs = jnp.asarray(x.reshape(chunk_steps, batch, -1))
+    xs = jnp.asarray(x.reshape(chunk_steps, batch, -1), dtype=jnp.bfloat16)
     ys = jnp.asarray(one_hot(y).reshape(chunk_steps, batch, -1))
     return xs, ys
 

@@ -50,7 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-ps", type=int, default=2,
                    help="parameter shard count for *_sharding variants "
                         "(reference run.sh arg $1; any split works — more "
-                        "shards than workers fold round-robin onto the mesh)")
+                        "shards than workers fold round-robin onto the mesh, "
+                        "and var-granular layouts clamp to one shard per "
+                        "variable beyond num_vars)")
     p.add_argument("--layout", default=None,
                    choices=["block", "zigzag", "lpt", "flat"],
                    help="shard layout policy (default: block for *_sharding, "
@@ -73,7 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-test", type=int, default=10_000,
                    help="procedural test-set size when --data is absent")
     p.add_argument("--bf16", action="store_true",
-                   help="bfloat16 compute (MXU fast path)")
+                   help="force bfloat16 compute (MXU fast path; the "
+                        "DEFAULT when the active platform is TPU)")
+    p.add_argument("--fp32", action="store_true",
+                   help="force fp32 compute (strict reference-numerics "
+                        "parity; the default off-TPU)")
     p.add_argument("--fused-adam", action="store_true",
                    help="use the hand-fused Pallas Adam kernel for the "
                         "sharded update (default: XLA-fused; see "
@@ -148,6 +154,30 @@ def _int_tuple(text: str) -> tuple[int, ...]:
         )
 
 
+def _resolve_dtype(args) -> str | None:
+    """Compute dtype: explicit flags win; otherwise bf16 on TPU (the MXU
+    runs bf16 at ~2x fp32 throughput and the model's accuracy is
+    insensitive — BASELINE.md records matching targets either way) and
+    fp32 elsewhere (strict parity with the reference's fp32 numerics)."""
+    if args.bf16 and args.fp32:
+        raise SystemExit("--bf16 and --fp32 are mutually exclusive")
+    if args.bf16:
+        return "bfloat16"
+    if args.fp32:
+        return None
+    import jax
+
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    if on_tpu:
+        print("[ddl_tpu] TPU platform: defaulting to bfloat16 compute "
+              "(--fp32 for strict fp32)")
+        return "bfloat16"
+    return None
+
+
 def config_from_args(args) -> "TrainConfig":
     from .train.config import TrainConfig
 
@@ -214,7 +244,7 @@ def config_from_args(args) -> "TrainConfig":
         grad_reduction="sum" if args.reference_compat else "mean",
         shard_data=shard_data,
         staleness_seed=args.staleness_seed,
-        compute_dtype="bfloat16" if args.bf16 else None,
+        compute_dtype=_resolve_dtype(args),
         fused_adam=args.fused_adam,
         conv_channels=conv_channels or (32, 64, 128, 256),
         fc_sizes=fc_sizes or (1024, 512),
@@ -387,6 +417,14 @@ def main(argv: list[str] | None = None) -> int:
             # machine-readable form of the reference's accuracy prints
             # (mnist_sync/worker.py:71-72).
             "history": [[e, b, round(a, 6)] for e, b, a in result.history],
+            # Async only: per-eval accuracies of every worker's stale
+            # replica (the reference's W per-worker accuracy streams,
+            # mnist_async/worker.py:71-75). null for sync/single.
+            "worker_history": (
+                [[e, b, [round(a, 6) for a in accs]]
+                 for e, b, accs in result.worker_history]
+                if result.worker_history is not None else None
+            ),
             "train_time_s": result.train_time_s,
             "images_per_sec": result.images_per_sec,
             "compile_time_s": result.compile_time_s,

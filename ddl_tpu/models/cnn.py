@@ -226,3 +226,16 @@ def accuracy(params: Params, x: jax.Array, y_onehot: jax.Array) -> jax.Array:
             jnp.float32
         )
     )
+
+
+def correct_count(params: Params, x: jax.Array, y_onehot: jax.Array) -> jax.Array:
+    """Number of top-1 hits (int32) — the accumulator form of
+    :func:`accuracy`, so a chunked full-test-set eval can run as ONE
+    compiled scan returning one scalar (ddl_tpu.train.trainer.evaluate)
+    instead of a host round-trip per chunk."""
+    logits = apply_fn(params, x, dropout_rng=None)
+    return jnp.sum(
+        (jnp.argmax(logits, axis=-1) == jnp.argmax(y_onehot, axis=-1)).astype(
+            jnp.int32
+        )
+    )

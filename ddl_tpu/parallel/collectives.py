@@ -24,9 +24,11 @@ Two sharded-update paths, selected by the layout policy:
   reduce-scatter (bandwidth-optimal, ~1x vector over ICI), update locally,
   ``all_gather`` back.
 - **var-aligned (block/zigzag/lpt)**: shard boundaries are unequal, so
-  reduce with ``psum``, slice the owned range per-device
-  (``lax.dynamic_slice`` at the mesh position's offset, padded to the max
-  shard size), update locally, ``all_gather`` + static-gather reassembly.
+  gather the flat vector into owner-major padded rows ``[W, max_shard]``
+  (a static overlap-tolerant gather, :func:`owner_slices`) and
+  ``psum_scatter`` the rows (:func:`reduce_scatter_rows`) — each device
+  receives only its reduced shard; update locally, ``all_gather`` +
+  static-gather reassembly.
 """
 
 from __future__ import annotations
@@ -113,6 +115,66 @@ def reduce_scatter_flat(
 # ---------------------------------------------------------------------------
 # Var-aligned (unequal shards) path
 # ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnerSlices:
+    """Static owner-major slicing plan for a var-aligned layout on a
+    ``num_devices`` mesh (the trace-time analogue of the reference PS's
+    shard-bound math, mnist_sync_sharding/parameter_server.py:30-32).
+
+    ``starts[s]`` is shard s's flat offset, padded to one entry per device
+    (surplus devices own an empty range parked at the zero padding tail);
+    ``pad_len`` bounds every ``(start, chunk)`` slice; ``slice_idx`` is the
+    ``[W, chunk]`` gather map row s = ``flat[starts[s] : starts[s]+chunk]``
+    (clipped positions land in the padding). Rows may OVERLAP for
+    unbalanced layouts — a gather, not a partition — which is what lets a
+    true reduce-scatter serve variable-aligned shard boundaries."""
+
+    starts: np.ndarray  # [W] int32 flat offsets
+    pad_len: int
+    slice_idx: np.ndarray  # [W, chunk] int32 gather map
+
+
+def owner_slices(layout: LayoutAssignment, num_devices: int) -> OwnerSlices:
+    chunk = layout.max_shard
+    starts = np.asarray(layout.shard_starts, np.int32)
+    if len(starts) < num_devices:
+        starts = np.concatenate([
+            starts,
+            np.full(num_devices - len(starts), layout.total, np.int32),
+        ])
+    pad_len = max(num_devices * chunk, layout.total + chunk)
+    slice_idx = np.minimum(
+        starts[:, None] + np.arange(chunk, dtype=np.int32)[None, :],
+        pad_len - 1,
+    )
+    return OwnerSlices(starts=starts, pad_len=pad_len, slice_idx=slice_idx)
+
+
+def owner_rows(flat: jax.Array, sl: OwnerSlices) -> jax.Array:
+    """Gather a flat vector into owner-major padded rows ``[W, chunk]``."""
+    return jnp.pad(flat, (0, sl.pad_len - flat.shape[0]))[
+        jnp.asarray(sl.slice_idx)
+    ]
+
+
+def reduce_scatter_rows(
+    flat: jax.Array, sl: OwnerSlices, axis: str, *, mean: bool,
+    num_devices: int
+) -> jax.Array:
+    """Inside shard_map: true fused reduce-scatter for a VAR-ALIGNED layout.
+    Gathers the local flat vector into owner-major rows (:func:`owner_rows`)
+    and ``psum_scatter``s rows, so this device receives ONLY its reduced
+    ``[chunk]`` shard (~W*chunk bytes over ICI vs a full ``psum``'s
+    ~2*total; ~2x fewer reduce bytes for balanced layouts). Numerically
+    identical to psum-then-slice up to reduction-order reassociation."""
+    shard = lax.psum_scatter(
+        owner_rows(flat, sl), axis, scatter_dimension=0, tiled=False
+    )
+    if mean:
+        shard = shard / num_devices
+    return shard
 
 
 def reassembly_index(layout: LayoutAssignment) -> np.ndarray:

@@ -255,3 +255,71 @@ def test_incompatible_checkpoint_is_diagnosed(small_dataset, small_params, tmp_p
                         fc_sizes=(16, 8), **base),
             small_dataset,
         ).train(log=lambda s: None, checkpoint_dir=d, resume=True)
+
+
+def test_cross_cadence_resume_trains_every_batch(
+    small_dataset, small_params, tmp_path
+):
+    """Elastic resume with a DIFFERENT eval cadence than the saving run
+    (round-3 advisor, medium): the checkpoint's start_step lands mid-span
+    of the resumed run's grid; the resume epoch's spans must realign to
+    start exactly there — skipping the whole span would silently drop up
+    to eval_every-1 batches while reporting them done. The Adam step
+    counter is the no-batch-left-behind oracle: it counts every applied
+    update."""
+    # Saving run: eval_every=2, checkpoint_every=3 over batch_num=8
+    # -> last durable save before the kill is step 3.
+    cfg_a = TrainConfig(epochs=1, batch_size=256, eval_every=2, seed=5)
+    d = str(tmp_path / "xc")
+    with pytest.raises(KeyboardInterrupt):
+        SingleChipTrainer(cfg_a, small_dataset, init=small_params).train(
+            log=Killer(4), checkpoint_dir=d, checkpoint_every=3
+        )
+
+    # Resumed run: eval_every=5 -> fresh spans (0,1)(1..5)(6..7); step 3
+    # is mid-span of (1..5). The realigned resume spans are (3..5)(6..7).
+    cfg_b = TrainConfig(epochs=1, batch_size=256, eval_every=5, seed=5)
+    trainer = SingleChipTrainer(cfg_b, small_dataset, init=small_params)
+    resumed = trainer.train(log=lambda s: None, checkpoint_dir=d, resume=True)
+    assert resumed.resumed_from_step == 3
+    # Every batch trained exactly once: 3 before the kill + 5 after.
+    assert int(trainer.opt_state.step) == 8
+    # And the result matches an uninterrupted run (span chunking may
+    # reassociate float ops across differently-compiled scans: ~1e-6).
+    ref = SingleChipTrainer(cfg_a, small_dataset, init=small_params).train(
+        log=lambda s: None
+    )
+    for k in ref.params:
+        np.testing.assert_allclose(
+            resumed.params[k], ref.params[k], atol=2e-6, err_msg=k
+        )
+
+
+def test_cross_cadence_resume_async_rounds(
+    small_dataset, small_params, tmp_path
+):
+    """Async analogue: the saving run's checkpoint can land mid-chunk of
+    the resumed run's round grid; chunks realign so every remaining round
+    (and its W pushes) runs. The global push counter t is the oracle."""
+    kw = dict(num_workers=8, num_ps=8, layout="block", batch_size=64, seed=4)
+    d = str(tmp_path / "xca")
+    # Saving run: eval_every=3 -> chunks (0,3)(3,4); checkpoint_every=2
+    # saves at round 3 (after the first chunk's eval). Kill at the SECOND
+    # eval line — the round-3 save is durable, the epoch-end one never
+    # happens.
+    with pytest.raises(KeyboardInterrupt):
+        AsyncTrainer(
+            TrainConfig(epochs=1, eval_every=3, **kw),
+            small_dataset, init=small_params,
+        ).train(log=Killer(2), checkpoint_dir=d, checkpoint_every=2)
+
+    # Resumed run: eval_every=2 -> fresh chunks (0,2)(2,4); round 3 is
+    # mid-chunk of (2,4); realigned resume chunks are (3,4).
+    trainer = AsyncTrainer(
+        TrainConfig(epochs=1, eval_every=2, **kw),
+        small_dataset, init=small_params,
+    )
+    resumed = trainer.train(log=lambda s: None, checkpoint_dir=d, resume=True)
+    assert resumed.resumed_from_step == 3
+    # 4 rounds x 8 pushes, every round served exactly once.
+    assert int(np.asarray(trainer.state.t)) == 32

@@ -58,7 +58,14 @@ def test_reference_hyperparameter_defaults():
 
 def test_bf16_flag():
     assert _cfg(["single", "--bf16"]).compute_dtype == "bfloat16"
+    # Off-TPU (this CPU test host) the auto default is fp32; on a TPU
+    # platform it would be bf16 (--fp32 to override) — cli._resolve_dtype.
     assert _cfg(["single"]).compute_dtype is None
+    assert _cfg(["single", "--fp32"]).compute_dtype is None
+    import pytest
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        _cfg(["single", "--bf16", "--fp32"])
 
 
 def test_default_batch_rounds_to_worker_multiple():

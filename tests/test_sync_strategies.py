@@ -163,3 +163,41 @@ def test_multiworker_aggregation_is_mean_not_doubled(batch, init):
     p, o, _ = step(params, opt, x, y, jax.random.PRNGKey(0))
     oracle = _single_steps(params, opt, x, y, 1)
     assert _max_abs_diff(p, oracle) < 1e-6
+
+
+def test_sharded_step_uses_true_reduce_scatter(batch, init):
+    """The var-aligned sharded step's only all-reduce is the SCALAR loss:
+    gradients move via reduce-scatter (each device receives ~max_shard
+    elements), never a full-vector all-reduce (every device receiving all
+    ``total`` reduced elements — ~2x the reduce bytes on a ring). Pins the
+    round-4 collective-schedule fix; benchmarks/collective_bytes.py reports
+    the same audit for every policy."""
+    x, y = batch
+    params, _ = init
+    W = 8
+    mesh = make_mesh(W)
+    cfg = TrainConfig(
+        num_workers=W, num_ps=7, layout="zigzag", keep_prob=1.0, batch_size=GB
+    )
+    layout = resolve_layout(cfg, W, _sizes(params))
+    step = make_sharded_step(cfg, mesh, layout, cnn.param_shapes(params))
+    sopt = sharded_adam_init(mesh, layout)
+    txt = step.lower(
+        params, sopt, x, y, jax.random.PRNGKey(0)
+    ).compile().as_text()
+
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from benchmarks.collective_bytes import collective_ops
+
+    ops = collective_ops(txt)
+    assert any(o["op"] == "reduce-scatter" for o in ops), (
+        "expected a reduce-scatter of the grads"
+    )
+    # Tuple-aware: max_elems covers every member of a fused result, so a
+    # full-vector all-reduce cannot hide behind a scalar sibling.
+    for o in ops:
+        if o["op"] == "all-reduce":
+            assert o["max_elems"] <= 1, f"non-scalar all-reduce survived: {o}"

@@ -113,7 +113,7 @@ def test_wait_backend_retries_until_window_closes(monkeypatch):
 
     def fake_probe(timeout_s=120.0):
         calls.append(timeout_s)
-        return len(calls) >= 3  # up on the third probe
+        return "tpu" if len(calls) >= 3 else "down"  # up on the third probe
 
     monkeypatch.setattr(mesh, "probe_backend_subprocess", fake_probe)
     logs = []
@@ -128,7 +128,7 @@ def test_wait_backend_retries_until_window_closes(monkeypatch):
     # Window exhausted: returns False instead of looping forever.
     calls.clear()
     monkeypatch.setattr(mesh, "probe_backend_subprocess",
-                        lambda timeout_s=120.0: (calls.append(1), False)[1])
+                        lambda timeout_s=120.0: (calls.append(1), "down")[1])
     assert not mesh.wait_backend(
         window_s=0.05, interval_s=0.01, probe_timeout_s=1.0
     )
@@ -139,12 +139,25 @@ def test_wait_backend_retries_until_window_closes(monkeypatch):
     assert not mesh.wait_backend(window_s=0.0, interval_s=0.01)
     assert len(calls) == 1
 
+    # A live NON-TPU backend is deterministic: fail fast, never retry —
+    # a CPU-only host must not spin out the whole window (and a CPU
+    # fallback must never greenlight a TPU measurement).
+    calls.clear()
+    monkeypatch.setattr(mesh, "probe_backend_subprocess",
+                        lambda timeout_s=120.0: (calls.append(1), "cpu")[1])
+    logs.clear()
+    assert not mesh.wait_backend(
+        window_s=60.0, interval_s=0.01, probe_timeout_s=1.0, log=logs.append
+    )
+    assert len(calls) == 1
+    assert any("not TPU" in m for m in logs)
 
-def test_probe_backend_subprocess_timeout_is_false():
+
+def test_probe_backend_subprocess_timeout_is_down():
     """A hung child (the tunnel handshake blocking) reads as 'backend still
-    down' — TimeoutExpired maps to False, never an exception. Deterministic
-    regardless of tunnel state: the timeout is shorter than Python startup,
-    so the child can never answer in time."""
+    down' — TimeoutExpired maps to "down", never an exception.
+    Deterministic regardless of tunnel state: the timeout is shorter than
+    Python startup, so the child can never answer in time."""
     from ddl_tpu.parallel.mesh import probe_backend_subprocess
 
-    assert probe_backend_subprocess(timeout_s=0.05) is False
+    assert probe_backend_subprocess(timeout_s=0.05) == "down"
