@@ -73,6 +73,7 @@ from ..train.trainer import (
     hit_target,
     save_crossed,
     staging_dtype,
+    steps_scan,
     try_resume,
 )
 from ..utils.checkpoint import save_checkpoint
@@ -244,7 +245,9 @@ def make_async_round(
             st, loss = round_fn(st, x_r, y_r, rng_r, sched_r)
             return st, loss
 
-        state, losses = lax.scan(body, state, (xs, ys, rngs, scheds))
+        state, losses = steps_scan(
+            body, state, (xs, ys, rngs, scheds), xs.shape[0]
+        )
         if sharded:
             gathered = lax.all_gather(state.ps, DP_AXIS, tiled=True)
             ps_full = gathered[jnp.asarray(reassembly)]
@@ -272,6 +275,30 @@ def make_async_round(
     return jax.jit(smapped, donate_argnums=donation_for(mesh, 0))
 
 
+def serve_layout_for(
+    config: TrainConfig, num_devices: int, sizes: dict[str, int] | None = None
+) -> LayoutAssignment | None:
+    """Serve placement for the async strategies: the user's resolved
+    layout, or — for the num_ps<=1 "one PS" on a multi-device mesh — a
+    synthesized equal-chunk flat layout routing the serve through the
+    sharded all_to_all machinery. The replicated serve would all-gather
+    the full [W, total] gradient matrix and run the identical W-push scan
+    redundantly on every device — O(W*total) work and memory per device
+    (round-3 verdict weak #5); sharding the serve state makes it O(total)
+    with two all_to_alls of ~total bytes. Because Adam is elementwise,
+    chunk placement never changes numerics (bit-identical, pinned by
+    tests/test_async.py) — "one logical PS" semantics are preserved
+    exactly. W=1 keeps the replicated path (no collectives to save).
+    Single source of truth for AsyncTrainer AND benchmarks/scaling.py, so
+    the bench always measures the product routing."""
+    layout = resolve_layout(config, num_devices, sizes)
+    if layout is None and num_devices > 1:
+        if sizes is None:
+            sizes = cnn.param_sizes()
+        layout = assign_layout("flat", num_devices, list(sizes), sizes)
+    return layout
+
+
 def make_worker_eval(mesh: Mesh, spec: coll.FlatSpec) -> Callable:
     """Per-worker stale-replica accuracy, evaluated IN PARALLEL: each mesh
     device scores its own worker's replica on the (replicated) test batch —
@@ -296,7 +323,7 @@ def make_worker_eval(mesh: Mesh, spec: coll.FlatSpec) -> Callable:
             x, y = xy
             return c + cnn.correct_count(params, x, y), None
 
-        c, _ = lax.scan(step, jnp.int32(0), (xs, ys))
+        c, _ = steps_scan(step, jnp.int32(0), (xs, ys), xs.shape[0])
         return lax.all_gather(c, DP_AXIS)  # [W] counts, replicated
 
     return jax.jit(jax.shard_map(
@@ -377,20 +404,9 @@ class AsyncTrainer:
         shapes = cnn.param_shapes(params)
         sizes = {k: int(np.prod(s)) if s else 1 for k, s in shapes.items()}
         self.layout = resolve_layout(config, W, sizes)
-        # Serve placement: the "one PS" (num_ps<=1) serve is routed through
-        # the sharded all_to_all machinery on any multi-device mesh, under a
-        # synthesized equal-chunk flat layout. The replicated serve would
-        # all-gather the full [W, total] gradient matrix and run the
-        # identical W-push scan redundantly on every device — O(W*total)
-        # work and memory per device (round-3 verdict weak #5); sharding the
-        # serve state makes it O(total) with two all_to_alls of ~total
-        # bytes. Because Adam is elementwise, chunk placement never changes
-        # numerics (bit-identical, pinned by tests/test_async.py) — so "one
-        # logical PS" semantics are preserved exactly. W=1 keeps the
-        # replicated path (no collectives to save).
-        self.serve_layout = self.layout
-        if self.serve_layout is None and W > 1:
-            self.serve_layout = assign_layout("flat", W, list(sizes), sizes)
+        # Serve placement (see serve_layout_for): num_ps<=1 routes through
+        # the sharded machinery on multi-device meshes.
+        self.serve_layout = serve_layout_for(config, W, sizes)
         self.state = async_state_init(config, self.mesh, self.serve_layout, params)
         self._run = make_async_round(config, self.mesh, self.serve_layout, shapes)
         self._spec = _flat_spec(self.serve_layout, shapes)
@@ -402,17 +418,18 @@ class AsyncTrainer:
         in parallel (one per device) and the whole-chunks pass is ONE
         dispatch + ONE [W] fetch (scan over test chunks inside the
         program, mirroring ``trainer.evaluate``); a ragged tail adds at
-        most one more dispatch."""
+        most one more dispatch. Chunking shared with ``evaluate`` via
+        ``trainer.eval_chunks``."""
+        from ..train.trainer import eval_chunks
+
         n = x_test.shape[0]
-        C, rem = divmod(n, batch)
+        whole, tail = eval_chunks(x_test, y_test, batch)
         counts = np.zeros(self.config.num_workers, np.int64)
-        if C:
-            xs = x_test[: C * batch].reshape(C, batch, *x_test.shape[1:])
-            ys = y_test[: C * batch].reshape(C, batch, *y_test.shape[1:])
-            counts += np.asarray(self._worker_eval(workers, xs, ys))
-        if rem:
+        if whole is not None:
+            counts += np.asarray(self._worker_eval(workers, *whole))
+        if tail is not None:
             counts += np.asarray(self._worker_eval(
-                workers, x_test[None, C * batch :], y_test[None, C * batch :]
+                workers, tail[0][None], tail[1][None]
             ))
         return [float(c) / n for c in counts]
 

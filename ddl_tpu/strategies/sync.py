@@ -66,6 +66,7 @@ from ..train.trainer import (
     resume_plan,
     save_crossed,
     staging_dtype,
+    steps_scan,
     try_resume,
 )
 from ..utils.checkpoint import save_checkpoint
@@ -309,8 +310,8 @@ def make_sync_epoch(
             params, opt_state, loss = step(params, opt_state, x, y, rng)
             return (params, opt_state), loss
 
-        (params, opt_state), losses = lax.scan(
-            body, (params, opt_state), jnp.arange(k)
+        (params, opt_state), losses = steps_scan(
+            body, (params, opt_state), jnp.arange(k), k
         )
         return params, opt_state, losses.mean()
 

@@ -172,6 +172,41 @@ def eval_spans(
     return spans
 
 
+# Max span/round-scan length that gets fully unrolled on non-TPU backends
+# (see steps_scan). The default eval cadence (10) and the test suite's
+# chunks sit under it; epoch-length eval_every=0 scans stay rolled to keep
+# compile time bounded.
+SCAN_UNROLL_CAP = 32
+
+
+def steps_scan(body, init, xs, k: int):
+    """``lax.scan`` for device-resident training spans, avoiding an
+    XLA:CPU control-flow pathology: convolution bodies inside a ``while``
+    op run ~6x slower than straight-line code on the CPU backend (measured
+    48s vs 8s per round for the async program at W=2 — the optimized conv
+    path is not used inside control flow). TPU is unaffected, so:
+
+    - ``k == 1``: inline the body — no while op at all (a rolled length-1
+      scan still pays the full penalty);
+    - non-TPU and ``k <= SCAN_UNROLL_CAP``: fully unrolled scan
+      (straight-line code, while op eliminated);
+    - otherwise (TPU, or long CPU scans): rolled scan — one compiled body,
+      bounded compile time.
+
+    Semantics are exactly ``lax.scan(body, init, xs)`` with a static
+    length ``k``; unrolling only reorders nothing (same per-step program,
+    same carry threading), so outputs match the rolled scan to XLA fusion
+    reassociation (~1e-7), the same envelope the span-vs-per-step parity
+    tests already pin."""
+    if k == 1:
+        carry, y = body(init, jax.tree.map(lambda a: a[0], xs))
+        return carry, jax.tree.map(lambda v: v[None], y)
+    unroll = (
+        k if (jax.default_backend() != "tpu" and k <= SCAN_UNROLL_CAP) else 1
+    )
+    return jax.lax.scan(body, init, xs, unroll=unroll)
+
+
 def resume_plan(
     start_step: int, batch_num: int, eval_every: int,
     spans: list[tuple[int, int, bool]],
@@ -216,8 +251,8 @@ def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
             params, opt_state, loss = step(params, opt_state, x, y, rng)
             return (params, opt_state), loss
 
-        (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), jnp.arange(k)
+        (params, opt_state), losses = steps_scan(
+            body, (params, opt_state), jnp.arange(k), k
         )
         return params, opt_state, losses.mean()
 
@@ -355,15 +390,33 @@ _jit_count = jax.jit(cnn.correct_count)
 
 @jax.jit
 def _count_scan(params, xs, ys):
-    """Chunked correct-count as ONE compiled dispatch: ``lax.scan`` over
-    ``[C, chunk, ...]`` test chunks, returning a single int32."""
+    """Chunked correct-count as ONE compiled dispatch: a scan over
+    ``[C, chunk, ...]`` test chunks, returning a single int32
+    (``steps_scan``: unrolled off-TPU — conv bodies in a rolled while op
+    are ~6x slower on XLA:CPU)."""
 
     def body(c, xy):
         x, y = xy
         return c + cnn.correct_count(params, x, y), None
 
-    c, _ = jax.lax.scan(body, jnp.int32(0), (xs, ys))
+    c, _ = steps_scan(body, jnp.int32(0), (xs, ys), xs.shape[0])
     return c
+
+
+def eval_chunks(x, y, batch: int):
+    """Shared test-set chunking for the fused eval paths: ``(whole, tail)``
+    where ``whole`` is ``([C, batch, ...], [C, batch, ...])`` (None when
+    the set is smaller than one chunk) and ``tail`` the ragged remainder
+    (None when it divides evenly). One place owns the divmod/reshape so
+    ``evaluate`` and the per-worker eval can never drift."""
+    n = x.shape[0]
+    C, rem = divmod(n, batch)
+    whole = (
+        x[: C * batch].reshape(C, batch, *x.shape[1:]),
+        y[: C * batch].reshape(C, batch, *y.shape[1:]),
+    ) if C else None
+    tail = (x[C * batch :], y[C * batch :]) if rem else None
+    return whole, tail
 
 
 def evaluate(
@@ -375,20 +428,13 @@ def evaluate(
     fetch (a scan over chunks) — the old per-chunk loop paid 5 host
     round-trips per eval on the 10k set (round-3 verdict weak #3); a
     ragged tail chunk adds at most one more dispatch."""
-    n = x_test.shape[0]
-    C, rem = divmod(n, batch)
+    whole, tail = eval_chunks(x_test, y_test_onehot, batch)
     correct = 0
-    if C:
-        xs = x_test[: C * batch].reshape(C, batch, *x_test.shape[1:])
-        ys = y_test_onehot[: C * batch].reshape(
-            C, batch, *y_test_onehot.shape[1:]
-        )
-        correct += int(_count_scan(params, xs, ys))
-    if rem:
-        correct += int(
-            _jit_count(params, x_test[C * batch :], y_test_onehot[C * batch :])
-        )
-    return correct / n
+    if whole is not None:
+        correct += int(_count_scan(params, *whole))
+    if tail is not None:
+        correct += int(_jit_count(params, *tail))
+    return correct / x_test.shape[0]
 
 
 class SingleChipTrainer:
