@@ -55,6 +55,34 @@ def make_mesh(
     return Mesh(np.asarray(devices), (axis,))
 
 
+def extend_cpu_collective_timeouts(warn_s: int = 120, kill_s: int = 900) -> None:
+    """Raise XLA:CPU's in-process collective rendezvous timeouts via
+    XLA_FLAGS (effective only BEFORE the CPU backend initializes).
+
+    The CPU runtime hard-aborts the process when the devices' threads do
+    not all reach a collective within ~40s of each other
+    (``rendezvous.cc`` "Termination timeout ... Exiting to ensure a
+    consistent program state"). On a loaded single-core host, 8 virtual
+    devices each running a multi-second program segment before a
+    collective can legitimately exceed that skew — a full-width W=8
+    per-worker eval was measured aborting this way. Flags already present
+    in XLA_FLAGS are respected."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    add = []
+    if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+        add.append(
+            f"--xla_cpu_collective_call_warn_stuck_timeout_seconds={warn_s}"
+        )
+    if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        add.append(
+            f"--xla_cpu_collective_call_terminate_timeout_seconds={kill_s}"
+        )
+    if add:
+        os.environ["XLA_FLAGS"] = (flags + " " + " ".join(add)).strip()
+
+
 def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
     """Point JAX at an ``n``-device virtual CPU platform — the hermetic
     surface every multi-chip strategy runs on when real chips are absent
@@ -75,6 +103,8 @@ def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
 
     import jax
 
+    # Only effective pre-init; harmless otherwise.
+    extend_cpu_collective_timeouts()
     if probe:
         if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
             # The caller's environment explicitly asked for CPU (e.g. the

@@ -14,6 +14,15 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Raise the CPU in-process collective rendezvous abort threshold: on a
+# loaded single-core host the 8 device threads can legitimately skew past
+# the default ~40s and the runtime HARD-ABORTS the process (see
+# mesh.extend_cpu_collective_timeouts). 300s (not the 900s bench default):
+# a REAL collective deadlock should still abort with the rendezvous
+# diagnostic well inside the suite's documented 600s chunk timeouts.
+from ddl_tpu.parallel.mesh import extend_cpu_collective_timeouts  # noqa: E402
+
+extend_cpu_collective_timeouts(kill_s=300)
 
 import jax  # noqa: E402
 

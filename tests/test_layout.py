@@ -202,3 +202,28 @@ def test_fold_shards_invariants_random_sweep():
         for st, sz in zip(folded.shard_starts, folded.shard_sizes):
             assert st == off
             off += sz
+
+
+def test_resolve_layout_clamps_num_ps_beyond_num_vars():
+    """num_ps > num_vars (the reference's degenerate run.sh 20 2, where
+    most PS own ZERO variables and the worker routing divides by zero,
+    mnist_sync_sharding/worker.py:33): var-granular policies clamp to one
+    shard per variable — the maximum var-aligned parallelism that exists —
+    then fold onto the mesh as usual; flat honors any split exactly."""
+    from ddl_tpu.strategies.sync import resolve_layout
+    from ddl_tpu.train.config import TrainConfig
+
+    n_vars = len(SIZES)
+    for policy in ("block", "zigzag", "lpt"):
+        lay = resolve_layout(
+            TrainConfig(num_workers=4, num_ps=n_vars + 6, layout=policy),
+            4, SIZES,
+        )
+        assert lay is not None and lay.num_shards == 4
+        # Every variable owned exactly once, no empty base shards implied.
+        assert sorted(lay.order) == sorted(SIZES)
+        assert sum(lay.shard_sizes) == sum(SIZES.values())
+    flat = resolve_layout(
+        TrainConfig(num_workers=4, num_ps=n_vars + 6, layout="flat"), 4, SIZES
+    )
+    assert flat is not None and flat.num_shards == 4

@@ -161,3 +161,31 @@ def test_probe_backend_subprocess_timeout_is_down():
     from ddl_tpu.parallel.mesh import probe_backend_subprocess
 
     assert probe_backend_subprocess(timeout_s=0.05) == "down"
+
+
+def test_steps_scan_matches_lax_scan():
+    """steps_scan's three regimes (k==1 inlined, k<=cap unrolled off-TPU,
+    k>cap rolled) are all exactly lax.scan semantics: same carry, same
+    stacked outputs — the XLA:CPU while-op pathology fix must never change
+    what a span computes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.train.trainer import SCAN_UNROLL_CAP, steps_scan
+
+    def body(c, xy):
+        a, b = xy
+        c = c * 0.5 + a - b
+        return c, c * 2.0
+
+    for k in (1, 3, SCAN_UNROLL_CAP, SCAN_UNROLL_CAP + 8):
+        xs = (jnp.arange(k, dtype=jnp.float32),
+              jnp.linspace(0.0, 1.0, k))
+        init = jnp.float32(1.0)
+        want_c, want_y = jax.lax.scan(body, init, xs)
+        got_c, got_y = jax.jit(
+            lambda i, x: steps_scan(body, i, x, k)
+        )(init, xs)
+        np.testing.assert_allclose(got_c, want_c, rtol=1e-6, err_msg=f"k={k}")
+        np.testing.assert_allclose(got_y, want_y, rtol=1e-6, err_msg=f"k={k}")
+        assert got_y.shape == (k,)
