@@ -116,8 +116,9 @@ def replicated_result(async_inputs):
 @pytest.mark.parametrize(
     "policy,num_ps",
     # num_ps=14 > _W devices: reference any-split topology, shards folded
-    # round-robin onto the mesh (layout.fold_shards).
-    [("block", 4), ("zigzag", 4), ("flat", 4), ("block", 14)],
+    # round-robin onto the mesh (layout.fold_shards). lpt@_W covers the
+    # most-unbalanced owner rows (largest overlap in the slice gather).
+    [("block", 4), ("zigzag", 4), ("flat", 4), ("lpt", _W), ("block", 14)],
 )
 def test_sharded_serve_equals_replicated_serve(
     async_inputs, replicated_result, policy, num_ps
