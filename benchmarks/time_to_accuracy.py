@@ -49,6 +49,12 @@ def main() -> int:
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="force the virtual CPU mesh")
+    ap.add_argument("--dispatch-timeout", type=float, default=0.0,
+                    help="seconds before a hung device dispatch/fetch is "
+                         "diagnosed as accelerator death (0 = wait forever)."
+                         " On the shared TPU tunnel a mid-run outage "
+                         "otherwise wedges this process in a native fetch "
+                         "with no way to retry")
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args()
 
@@ -90,7 +96,8 @@ def main() -> int:
         trainer = AsyncTrainer(cfg, ds)
 
     t0 = time.perf_counter()
-    r = trainer.train(log=lambda s: print(f"[tta] {s}", file=sys.stderr))
+    r = trainer.train(log=lambda s: print(f"[tta] {s}", file=sys.stderr),
+                      dispatch_timeout=args.dispatch_timeout)
     wall = time.perf_counter() - t0
 
     crossing = next(

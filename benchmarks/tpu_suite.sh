@@ -46,9 +46,12 @@ mv "$R/adam_kernel_tpu.json.tmp" "$R/adam_kernel_tpu.json"
 # shards onto the one device — degenerate as parallelism but they execute
 # the REAL sharded programs (reduce-scatter/all_to_all serve, donation,
 # Pallas path selection) on TPU, which no CPU test can.
-for v in single sync async sync_sharding async_sharding; do
-  python benchmarks/time_to_accuracy.py --variant "$v" --workers 1 \
-    --target 0.99 --max-epochs 20 --bf16 \
-    --json "$R/tta_${v}.json.tmp" 2>"$R/tta_${v}.log"
-  mv "$R/tta_${v}.json.tmp" "$R/tta_${v}.json"
+# Row config (timeouts, target, dtype) AND the variant list live in
+# tta_row.sh, shared with the retry watcher (tta_watch.sh) so the two
+# can never drift. The list goes through an assignment so a failing
+# `--list` stops the suite under set -e (a bare $(...) in the for-line
+# would silently iterate zero rows and "succeed").
+TTA_VARIANTS=$(sh benchmarks/tta_row.sh --list)
+for v in $TTA_VARIANTS; do
+  sh benchmarks/tta_row.sh "$v"
 done

@@ -1,0 +1,29 @@
+#!/bin/sh
+# One time-to-accuracy row: benchmarks/tta_row.sh <variant>
+# Shared by tpu_suite.sh (the one-shot suite) and tta_watch.sh (the retry
+# watcher) so the two can never drift in configuration: W=1 on the real
+# chip, full-width model, target 0.99, bf16. --dispatch-timeout turns a
+# mid-run tunnel death into a diagnosed abort (the trainer watchdog); the
+# outer `timeout` additionally bounds hangs the watchdog cannot see (AOT
+# compile RPCs happen before the watchdog arms — round 4 observed a
+# compile-phase wedge sleeping in a native socket read for 15+ min).
+# Writes $R/tta_<variant>.json only on success (tmp + move), so a failed
+# re-run never clobbers a good row.
+set -u
+cd "$(dirname "$0")/.."
+R=benchmarks/results
+mkdir -p "$R"
+# The canonical row set — `tta_row.sh --list` prints it so tpu_suite.sh
+# and tta_watch.sh iterate the SAME variants (neither hardcodes the list).
+VARIANTS="single sync async sync_sharding async_sharding"
+if [ "${1:-}" = "--list" ]; then
+  echo "$VARIANTS"
+  exit 0
+fi
+v="$1"
+timeout "${TTA_ROW_TIMEOUT_S:-2400}" \
+  python benchmarks/time_to_accuracy.py --variant "$v" \
+  --workers 1 --target 0.99 --max-epochs 20 --bf16 \
+  --dispatch-timeout 300 \
+  --json "$R/tta_${v}.json.tmp" 2>"$R/tta_${v}.log" || exit $?
+mv "$R/tta_${v}.json.tmp" "$R/tta_${v}.json"
