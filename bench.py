@@ -16,8 +16,11 @@ BASELINE.md "measurement integrity").
 Extras in the same JSON line: a batch-size sweep with BOTH best-of-N and
 median-of-N per batch (the tunnel chip is shared and run-to-run variance
 reaches ~5x; best = capability, median = expected — regression tracking
-should watch the median), the analytic model-FLOPs estimate, and MFU vs the
-chip's peak. ``vs_baseline`` compares against a torch-CPU implementation of
+should watch the median), a long-span row (same program, span k=120 — one
+dispatch per bracket, amortizing the tunnel's per-dispatch cost the way
+the product's epoch-length spans do; it participates in the headline
+``value``), the analytic model-FLOPs estimate, and MFU vs the chip's
+peak. ``vs_baseline`` compares against a torch-CPU implementation of
 the same CNN + Adam step measured in-process at the SAME batch size (200) —
 a stand-in for the reference's CPU TensorFlow runtime (the reference
 publishes no numbers, SURVEY.md §6).
@@ -269,6 +272,23 @@ def main() -> None:
           f"median {statistics.median(sync_vals):,.0f} images/s",
           file=sys.stderr)
 
+    # Long-span row: the SAME product program at span k=120 (one dispatch
+    # per timing bracket). The sweep's k=30/rounds=3 brackets pay the
+    # tunnel's per-dispatch cost every 30 steps; the product trainer
+    # dispatches epoch-length spans whenever eval_every is 0 or >=k, so
+    # the amortized number is also a product-path capability, not a
+    # synthetic best case. The step-time decomposition behind this row:
+    # benchmarks/step_anatomy.py.
+    long_k = 120
+    long_vals = bench_single(best_batch, repeats, chunk_steps=long_k,
+                             rounds=1)
+    print(f"[bench] long span k={long_k} batch {best_batch}: "
+          f"best {max(long_vals):,.0f} "
+          f"median {statistics.median(long_vals):,.0f} images/s",
+          file=sys.stderr)
+    if max(long_vals) > best:
+        best = max(long_vals)
+
     flops_per_image = train_step_flops_per_image()
     peak = _chip_peak_flops()
     mfu_pct = (
@@ -294,6 +314,12 @@ def main() -> None:
             "best": round(max(sync_vals), 1),
             "median": round(statistics.median(sync_vals), 1),
             "batch": best_batch,
+        },
+        "long_span": {
+            "best": round(max(long_vals), 1),
+            "median": round(statistics.median(long_vals), 1),
+            "batch": best_batch,
+            "chunk_steps": long_k,
         },
         "flops_per_image": round(flops_per_image),
         "mfu_pct": mfu_pct,

@@ -30,35 +30,39 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+# Runnable as a script from anywhere: the package and bench.py live at the
+# repo root, one level above this file.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
+import bench
 from ddl_tpu.data import one_hot, synthesize
 from ddl_tpu.models import cnn
 from ddl_tpu.ops import adam_init, adam_update
 from ddl_tpu.train.config import TrainConfig
-from ddl_tpu.train.trainer import (
-    force,
-    make_epoch_chunk,
-    make_train_step,
-    steps_scan,
-)
+from ddl_tpu.train.trainer import force, make_train_step, steps_scan
 
 
 def timed(fn, args, *, iters: int, repeats: int) -> float:
     """Best-of-repeats seconds per repetition of ``fn(*args)``.
 
     One compiled program runs ``iters`` repetitions in a ``steps_scan``;
-    the carry is a ~zero float token added to params["v0"] each
-    repetition and recomputed as ``min(sum(EVERY output element), 0) *
-    1e-20``: reducing over ALL leaves keeps every output live (a token
-    built from one element lets XLA dead-code-eliminate the rest of the
-    computation — observed collapsing the Adam piece 1000x), the data
-    dependence means the body can neither be hoisted out of the loop nor
-    left unexecuted on the deferred tunnel backend, and the 1e-20 scale
-    means params are unperturbed at fp32/bf16 precision. Each timing
+    the carry is a ~zero float token added to EVERY float leaf of every
+    argument each repetition (params, opt state, grads, batch — and the
+    repetition index is folded into raw PRNG-key leaves), recomputed as
+    ``min(sum(EVERY output element), 0) * 1e-20``: perturbing all inputs
+    leaves nothing loop-invariant to hoist (constant grads/opt let XLA
+    hoist Adam's whole m'/v' chain; a constant key hoists the threefry
+    generation), reducing over ALL leaves keeps every output live (a
+    token built from one element lets XLA dead-code-eliminate the rest —
+    observed collapsing the Adam piece 1000x), and the 1e-20 scale means
+    the values are unperturbed at fp32/bf16 precision. Each timing
     bracket is ONE dispatch + one scalar fetch.
     """
 
@@ -113,7 +117,6 @@ def main() -> None:
     ap.add_argument("--json", type=str, default=None)
     args = ap.parse_args()
 
-    cfg100 = TrainConfig(batch_size=args.batches[0], compute_dtype="bfloat16")
     params = cnn.init_params(jax.random.PRNGKey(0))
     opt = adam_init(params)
     rng = jax.random.PRNGKey(1)
@@ -167,30 +170,19 @@ def main() -> None:
 
     # Span-length scaling at the smaller batch: per-step time vs k separates
     # per-dispatch overhead (falls ~1/k) from per-step XLA work (flat).
+    # Measured through bench.bench_single — the SAME loop as the committed
+    # bench rows (AOT compile, chained span dispatches, host-fetch
+    # barrier), so this curve is directly comparable to bench.py's sweep
+    # (k=30) and long_span (k=120) rows.
     b = args.batches[0]
-    span_lengths = tuple(args.spans)
-    x, y = synthesize(max(span_lengths) * b, seed=0)
     spans = {}
-    for k in span_lengths:
-        xs = jnp.asarray(x[: k * b].reshape(k, b, -1), dtype=jnp.bfloat16)
-        ys = jnp.asarray(one_hot(y[: k * b]).reshape(k, b, -1))
-        fn = make_epoch_chunk(cfg100, k)
-        zero = jnp.int32(0)
-        p = jax.tree.map(jnp.copy, params)
-        o = jax.tree.map(jnp.copy, opt)
-        compiled = fn.lower(p, o, xs, ys, zero, zero, rng).compile()
-        p, o, _ = compiled(p, o, xs, ys, zero, zero, rng)
-        force((p, o))  # barrier: warmup span
-        best = float("inf")
-        iters = max(1, 60 // k)
-        for _ in range(args.repeats):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                p, o, loss = compiled(p, o, xs, ys, zero, zero, rng)
-            force((p, o, loss))  # barrier: last span of the chain
-            best = min(best, (time.perf_counter() - t0) / (iters * k))
-        spans[k] = round(best * 1e6, 1)
-        print(f"[anatomy] span k={k} batch {b}: {best*1e6:,.0f}us/step")
+    for k in args.spans:
+        vals = bench.bench_single(
+            b, args.repeats, chunk_steps=k, rounds=max(1, 60 // k)
+        )
+        us_per_step = b / max(vals) * 1e6
+        spans[k] = round(us_per_step, 1)
+        print(f"[anatomy] span k={k} batch {b}: {us_per_step:,.0f}us/step")
     report["span_us_per_step"] = spans
 
     line = json.dumps(report)

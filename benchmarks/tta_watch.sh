@@ -72,5 +72,13 @@ print(probe_backend_subprocess())
   # deterministic failure cannot hot-spin the loop.
   [ "$failed" -eq 1 ] && sleep 120
 done
-echo "[tta_watch] window expired; missing rows remain"
+# The last cycle may have finished the final rows after the deadline
+# passed — recompute before reporting, so success is never misreported
+# as "missing rows remain" (exit-code consumers gate on this).
+missing=""
+for v in $VARIANTS; do
+  [ -f "$R/tta_${v}.json" ] || missing="$missing $v"
+done
+[ -z "$missing" ] && { echo "[tta_watch] all rows done"; exit 0; }
+echo "[tta_watch] window expired; missing rows remain:$missing"
 exit 1
