@@ -16,3 +16,11 @@ from .collectives import (  # noqa: F401
     reassembly_index,
     unflatten_params,
 )
+from .ring import (  # noqa: F401
+    full_attention,
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention_shard,
+    seq_sharding,
+    ulysses_attention_shard,
+)

@@ -1,0 +1,190 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context support beyond the reference's feature matrix (the reference
+has no attention and no sequence axis at all — fixed 784-pixel images,
+mnist_sync/model/model.py:18-19; SURVEY.md §5 records sequence
+parallelism as owed nothing for parity). This module adds the two
+standard TPU-native sequence-parallel schemes as first-class mesh
+programs, so models with a sequence dimension scale past one chip's HBM:
+
+- **Ring attention** (:func:`ring_attention_shard`): Q stays resident;
+  K/V blocks rotate around the mesh axis via ``lax.ppermute`` (ICI
+  neighbour links — the mesh axis follows the physical torus, see
+  ``mesh.make_mesh``). Attention is EXACT: the streaming-softmax state
+  ``(m, l, acc)`` is rescaled per block (the FlashAttention/online-softmax
+  recurrence), so P ring steps reproduce full softmax over the whole
+  sequence while each device only ever materializes a ``[Tq_local,
+  Tk_local]`` score tile. Memory per device: O(T/P) sequence, O(T/P * T/P)
+  scores — the whole point of the scheme.
+- **Ulysses / all-to-all** (:func:`ulysses_attention_shard`): two
+  ``lax.all_to_all``s re-partition sequence-sharded activations to
+  head-sharded ones and back; attention itself is an ordinary full-
+  sequence computation over each device's head subset. Cheaper in
+  collective count when ``num_heads >= P``; requires ``num_heads % P == 0``.
+
+Both are pure per-shard functions for use inside ``shard_map`` (the same
+contract as ``collectives.py``), plus jitted whole-array wrappers
+(:func:`make_ring_attention`, :func:`make_ulysses_attention`) that place
+global ``[B, T, H, D]`` arrays sequence-sharded over the mesh axis.
+Causal masking uses absolute positions (``lax.axis_index`` offsets), and
+the ring starts on each device's own diagonal block so a causal sweep
+never sees an all-masked first tile (the streaming state would otherwise
+need NaN guards for ``exp(-inf - -inf)``).
+
+Tests pin both schemes (fwd + grad, causal and not) against a
+single-device oracle on the 8-device virtual mesh: tests/test_ring.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DP_AXIS
+
+_MASKED = -1e30  # large-negative (not -inf): keeps exp(s - m) NaN-free
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: float | None = None, q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Plain softmax attention, ``[B, T, H, D]`` — the single-device oracle
+    and the local kernel inside the Ulysses scheme. ``q_offset``/``k_offset``
+    are the absolute positions of element 0 (needed when the caller holds a
+    shard of the sequence), so causal masking is correct under sharding."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where(kpos[None, :] <= qpos[:, None], s, _MASKED)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ring_attention_shard(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+    axis_size: int, causal: bool = False, scale: float | None = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name``; call
+    INSIDE ``shard_map``. Per-shard shapes ``[B, T/P, H, D]``.
+
+    P ring steps; at step r this device holds K/V block ``(i - r) % P``
+    (blocks rotate ``i -> i+1`` via ``ppermute`` — neighbour traffic on
+    ICI). The online-softmax state is carried in fp32 regardless of input
+    dtype; output is cast back to ``q.dtype``.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    i = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qpos = i * Tq + jnp.arange(Tq)
+
+    m = jnp.full((B, H, Tq), _MASKED, dtype=jnp.float32)
+    l = jnp.zeros((B, H, Tq), dtype=jnp.float32)
+    acc = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    for r in range(axis_size):
+        j = (i - r) % axis_size  # owner of the block currently held
+        s_tile = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s_tile = s_tile * scale
+        if causal:
+            kpos = j * Tk + jnp.arange(Tk)
+            s_tile = jnp.where(
+                kpos[None, :] <= qpos[:, None], s_tile, _MASKED
+            )
+        m_new = jnp.maximum(m, s_tile.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(s_tile - m_new[..., None])
+        l = l * correction + p.sum(axis=-1)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        )
+        m = m_new
+        if r != axis_size - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_shard(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+    axis_size: int, causal: bool = False, scale: float | None = None,
+) -> jax.Array:
+    """Ulysses sequence parallelism; call INSIDE ``shard_map``. Per-shard
+    ``[B, T/P, H, D]`` with ``H % P == 0``: one ``all_to_all`` turns the
+    sequence sharding into a head sharding ``[B, T, H/P, D]``, a plain
+    full-sequence :func:`full_attention` runs on the local head subset,
+    and a second ``all_to_all`` restores sequence sharding."""
+    H = q.shape[2]
+    if H % axis_size:
+        raise ValueError(
+            f"ulysses needs num_heads % axis_size == 0, got {H} % {axis_size}"
+        )
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    back = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
+        tiled=True,
+    )
+    out = full_attention(
+        a2a(q), a2a(k), a2a(v), causal=causal, scale=scale
+    )
+    return back(out)
+
+
+def seq_sharding(mesh: Mesh, axis: str = DP_AXIS) -> NamedSharding:
+    """The ``[B, T, H, D]`` sequence-sharded placement both wrappers
+    expect — ``jax.device_put(x, seq_sharding(mesh))`` stages inputs
+    without relying on the jit boundary to insert the transfer."""
+    return NamedSharding(mesh, P(None, axis))
+
+
+def _make_wrapper(shard_fn, mesh: Mesh, axis: str, causal: bool):
+    P_ = mesh.shape[axis]
+    spec = P(None, axis)
+
+    @jax.jit
+    def fn(q, k, v):
+        return jax.shard_map(
+            functools.partial(
+                shard_fn, axis_name=axis, axis_size=P_, causal=causal
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+    return fn
+
+
+def make_ring_attention(
+    mesh: Mesh, *, axis: str = DP_AXIS, causal: bool = False
+):
+    """Jitted ring attention over global ``[B, T, H, D]`` arrays sharded
+    on ``T`` along ``mesh``'s ``axis`` (``T % mesh.shape[axis] == 0``).
+    Use :func:`jax.device_put` with ``NamedSharding(mesh, P(None, axis))``
+    to place inputs (the wrapper's jit will otherwise insert the
+    placement transfer itself)."""
+    return _make_wrapper(ring_attention_shard, mesh, axis, causal)
+
+
+def make_ulysses_attention(
+    mesh: Mesh, *, axis: str = DP_AXIS, causal: bool = False
+):
+    """Jitted Ulysses attention over global ``[B, T, H, D]`` arrays
+    sharded on ``T`` (``T`` and ``H`` both divisible by the axis size)."""
+    return _make_wrapper(ulysses_attention_shard, mesh, axis, causal)
