@@ -6,6 +6,8 @@ c ~= 2.3us/image — the fixed term alone caps batch-100 throughput at
 of the step at two batch sizes to attribute ``a``:
 
   fwd        forward pass only (no dropout)
+  fwd_patches  forward with the cin=1 first conv as a patches matmul
+             (cnn._patches_block) — vs `fwd` decides the MXU-lane question
   fwd_drop   forward with dropout RNG (isolates threefry/bernoulli cost)
   grad       value_and_grad (fwd+bwd), no optimizer
   adam       Adam update alone on full-width grads (batch-independent)
@@ -125,6 +127,14 @@ def main() -> None:
     def fwd(params, x):
         return cnn.apply_fn(params, x, compute_dtype=jnp.bfloat16)
 
+    def fwd_patches(params, x):
+        # First conv as patches-matmul (cnn._patches_block) — measured
+        # against `fwd` to decide whether the cin=1 conv lowering wastes
+        # MXU lanes in practice.
+        return cnn.apply_fn(
+            params, x, compute_dtype=jnp.bfloat16, first_conv_matmul=True
+        )
+
     def fwd_drop(params, x, rng):
         return cnn.apply_fn(
             params, x, dropout_rng=rng, compute_dtype=jnp.bfloat16
@@ -153,6 +163,7 @@ def main() -> None:
         rows = {}
         for name, fn, a in (
             ("fwd", fwd, (params, xb)),
+            ("fwd_patches", fwd_patches, (params, xb)),
             ("fwd_drop", fwd_drop, (params, xb, rng)),
             ("grad", gradp, (params, xb, yb, rng)),
         ):

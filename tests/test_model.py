@@ -131,3 +131,25 @@ def test_glorot_init_stats():
     limit = np.sqrt(6.0 / (1024 + 1024))
     assert np.abs(w).max() <= limit
     assert w.std() == pytest.approx(limit / np.sqrt(3), rel=0.05)
+
+
+def test_first_conv_matmul_matches_conv():
+    """The patches-matmul first conv (MXU lane-waste fix for cin=1,
+    cnn._patches_block) is numerically the conv path: same logits for
+    eval AND the same dropout stream for train mode."""
+    from jax import lax
+
+    params = cnn.init_params(jax.random.PRNGKey(5))
+    x = jax.random.uniform(jax.random.PRNGKey(6), (8, 784))
+    a = cnn.apply_fn(params, x, precision=lax.Precision.HIGHEST)
+    b = cnn.apply_fn(
+        params, x, precision=lax.Precision.HIGHEST, first_conv_matmul=True
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    key = jax.random.PRNGKey(7)
+    at = cnn.apply_fn(params, x, dropout_rng=key,
+                      precision=lax.Precision.HIGHEST)
+    bt = cnn.apply_fn(params, x, dropout_rng=key,
+                      precision=lax.Precision.HIGHEST,
+                      first_conv_matmul=True)
+    np.testing.assert_allclose(np.asarray(at), np.asarray(bt), atol=1e-5)

@@ -31,6 +31,22 @@ def test_full_attention_matches_manual_softmax():
     np.testing.assert_allclose(out, expect, atol=1e-5)
 
 
+def test_full_attention_shard_offsets():
+    """q_offset/k_offset make causal masking correct on sequence SHARDS:
+    rows computed from a q-shard against the full K/V with the shard's
+    absolute offset equal the corresponding rows of the unsharded
+    output."""
+    q, k, v = _qkv(seed=4)
+    full = ring.full_attention(q, k, v, causal=True)
+    t0 = T // 2
+    shard = ring.full_attention(
+        q[:, t0:], k, v, causal=True, q_offset=t0, k_offset=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(shard), np.asarray(full[:, t0:]), atol=1e-5
+    )
+
+
 def test_full_attention_causal_masks_future():
     q, k, v = _qkv()
     out = ring.full_attention(q, k, v, causal=True)
