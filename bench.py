@@ -286,8 +286,10 @@ def main() -> None:
           f"best {max(long_vals):,.0f} "
           f"median {statistics.median(long_vals):,.0f} images/s",
           file=sys.stderr)
+    headline_source = "sweep_k30"
     if max(long_vals) > best:
         best = max(long_vals)
+        headline_source = f"long_span_k{long_k}"
 
     flops_per_image = train_step_flops_per_image()
     peak = _chip_peak_flops()
@@ -321,6 +323,7 @@ def main() -> None:
             "batch": best_batch,
             "chunk_steps": long_k,
         },
+        "headline_source": headline_source,
         "flops_per_image": round(flops_per_image),
         "mfu_pct": mfu_pct,
         "program": "ddl_tpu.train.trainer.make_epoch_chunk (product path); "

@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the hand-fused Pallas Adam kernel for the "
                         "sharded update (default: XLA-fused; see "
                         "benchmarks/adam_kernel.py for the comparison)")
+    p.add_argument("--conv1-matmul", action="store_true",
+                   help="lower the 1-input-channel first conv as an "
+                        "explicit patches-matmul (MXU lane utilization; "
+                        "1e-5-level numerics difference — measured vs the "
+                        "conv lowering by benchmarks/step_anatomy.py)")
     p.add_argument("--conv-channels", type=_int_tuple, default=None,
                    metavar="C1,C2,C3,C4",
                    help="conv widths of the model family (default "
@@ -246,6 +251,7 @@ def config_from_args(args) -> "TrainConfig":
         staleness_seed=args.staleness_seed,
         compute_dtype=_resolve_dtype(args),
         fused_adam=args.fused_adam,
+        conv1_matmul=args.conv1_matmul,
         conv_channels=conv_channels or (32, 64, 128, 256),
         fc_sizes=fc_sizes or (1024, 512),
     )

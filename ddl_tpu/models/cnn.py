@@ -164,7 +164,7 @@ def _patches_block(
     against each other on hardware (benchmarks/step_anatomy.py) rather
     than guessed at.
     """
-    n, h, ww, cin = x.shape
+    n, h, ww, _ = x.shape
     patches = lax.conv_general_dilated_patches(
         x,
         filter_shape=(5, 5),
@@ -245,6 +245,7 @@ def loss_fn(
     keep_prob: float = 0.5,
     compute_dtype=None,
     precision: lax.Precision | None = None,
+    first_conv_matmul: bool = False,
 ) -> jax.Array:
     """Mean softmax cross-entropy (model.py:91-92)."""
     logits = apply_fn(
@@ -254,6 +255,7 @@ def loss_fn(
         keep_prob=keep_prob,
         compute_dtype=compute_dtype,
         precision=precision,
+        first_conv_matmul=first_conv_matmul,
     )
     logprobs = jax.nn.log_softmax(logits)
     return -jnp.mean(jnp.sum(y_onehot * logprobs, axis=-1))

@@ -62,6 +62,14 @@ class TrainConfig:
     # measured against XLA by benchmarks/adam_kernel.py).
     fused_adam: bool = False
 
+    # Route the 1-input-channel first conv through an explicit
+    # patches-matmul (models/cnn.py _patches_block) instead of the conv
+    # lowering — the cin=1 contraction depth (25) underfills the MXU's
+    # 128 reduction lanes; measured head-to-head on hardware by
+    # benchmarks/step_anatomy.py (fwd vs fwd_patches). 1e-5-level
+    # numerics difference vs the conv lowering (contraction order).
+    conv1_matmul: bool = False
+
     # Early stop: end training at the first eval whose full-test-set
     # accuracy reaches this target (None = run all epochs). Evals happen
     # every ``eval_every`` batches — that is the detection granularity.

@@ -129,6 +129,16 @@ def test_main_reference_compat_end_to_end(capsys):
     assert payload["config"]["shard_data"] is False
 
 
+def test_main_conv1_matmul_end_to_end(capsys):
+    """--conv1-matmul (patches-matmul first conv) trains end-to-end through
+    the DP collective path; model-level numerics parity is pinned by
+    tests/test_model.py::test_first_conv_matmul_matches_conv."""
+    payload = _run_main(
+        ["sync", "--num-workers", "8", "--conv1-matmul"] + _E2E, capsys
+    )
+    assert payload["config"]["conv1_matmul"] is True
+
+
 def test_main_checkpoint_resume_roundtrip(tmp_path, capsys):
     d = str(tmp_path / "ckpt")
     args = ["sync_sharding", "--num-workers", "8", "--num-ps", "8",
