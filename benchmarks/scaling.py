@@ -29,7 +29,6 @@ from ddl_tpu.parallel.mesh import virtual_cpu_mesh  # noqa: E402
 def bench_strategy(variant: str, workers: int, steps: int, batch: int) -> float:
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ddl_tpu.data import one_hot, synthesize

@@ -47,7 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..data import Dataset, one_hot
 from ..models import cnn
-from ..ops import AdamState, adam_init, adam_update
+from ..ops import adam_init, adam_update
 from ..parallel import collectives as coll
 from ..parallel import multihost
 from ..parallel.layout import LayoutAssignment, assign_layout, fold_shards
