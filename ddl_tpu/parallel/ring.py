@@ -94,8 +94,7 @@ def ring_attention_shard(
     acc = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
     perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
 
-    for r in range(axis_size):
-        j = (i - r) % axis_size  # owner of the block currently held
+    def block_update(m, l, acc, k, v, j):
         s_tile = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         s_tile = s_tile * scale
         if causal:
@@ -110,7 +109,27 @@ def ring_attention_shard(
         acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
         )
-        m = m_new
+        return m_new, l, acc
+
+    for r in range(axis_size):
+        j = (i - r) % axis_size  # owner of the block currently held
+        if causal and r > 0 and Tk >= Tq:
+            # Blocks strictly in the future (j > i) are ENTIRELY masked
+            # when kpos_min = j*Tk >= qpos_max+1 = i*Tq + Tq, guaranteed
+            # by Tk >= Tq (static check — with Tk < Tq a j > i block can
+            # still hold attended positions and must run the masked
+            # update): skip their score/update compute per device with
+            # lax.cond — the causal sweep does ~half the off-diagonal
+            # block work. r == 0 is the diagonal block (j == i), always
+            # computed.
+            m, l, acc = lax.cond(
+                j > i,
+                lambda m, l, acc, k, v, j: (m, l, acc),
+                block_update,
+                m, l, acc, k, v, j,
+            )
+        else:
+            m, l, acc = block_update(m, l, acc, k, v, j)
         if r != axis_size - 1:
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)

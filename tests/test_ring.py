@@ -186,3 +186,30 @@ def test_ring_attention_trains_end_to_end():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_ring_attention_shorter_kv_causal():
+    """Tq != Tk per shard: the causal block-skip must NOT fire when
+    Tk < Tq (a j > i block can still hold attended positions); result
+    equals the oracle over the shorter K/V sequence."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    q, _, _ = _qkv(seed=5)          # [B, 64, H, D]
+    _, k, v = _qkv(seed=6)
+    k, v = k[:, : T // 2], v[:, : T // 2]  # [B, 32, H, D] -> Tk=4/shard
+
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring.ring_attention_shard(
+                q, k, v, axis_name=DP_AXIS, axis_size=8, causal=True
+            ),
+            mesh=mesh,
+            in_specs=(P(None, DP_AXIS),) * 3,
+            out_specs=P(None, DP_AXIS),
+        )
+    )(q, k, v)
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
