@@ -121,7 +121,11 @@ def ring_attention_shard(
             # update): skip their score/update compute per device with
             # lax.cond — the causal sweep does ~half the off-diagonal
             # block work. r == 0 is the diagonal block (j == i), always
-            # computed.
+            # computed. NOTE: the saving is per-device compute (energy /
+            # shared-core throughput); ring steps stay lockstep at the
+            # ppermute, and at every step some device holds an unmasked
+            # block, so wall-clock latency is unchanged — balancing it
+            # needs a striped block layout, out of scope here.
             m, l, acc = lax.cond(
                 j > i,
                 lambda m, l, acc, k, v, j: (m, l, acc),

@@ -213,3 +213,51 @@ def test_ring_attention_shorter_kv_causal():
     )(q, k, v)
     expect = ring.full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
+
+
+def test_ring_attention_memory_is_blockwise():
+    """The point of ring attention: per-device temp memory scales with the
+    (T/P)^2 block, not the T^2 score matrix. Pinned via XLA's compiled
+    memory analysis — the ring program's per-device temp must be a small
+    fraction of single-device full attention's at the same global shape."""
+    mesh = make_mesh(8)
+    Tbig = 2048
+    q = jnp.zeros((1, Tbig, 4, 16))
+
+    ring_c = ring.make_ring_attention(mesh).lower(q, q, q).compile()
+    full_c = jax.jit(ring.full_attention).lower(q, q, q).compile()
+    ring_tmp = ring_c.memory_analysis().temp_size_in_bytes
+    full_tmp = full_c.memory_analysis().temp_size_in_bytes
+    # Full attention materializes B*H*T^2 fp32 scores (~67 MB here); the
+    # ring tile is (T/8)^2 per (B, H). Require a 4x margin so the bound
+    # is robust to fusion/layout choices, not a brittle exact number.
+    assert full_tmp > 4 * ring_tmp, (ring_tmp, full_tmp)
+
+
+def test_ring_attention_longer_kv_causal():
+    """Tk > Tq per shard: on devices whose diagonal block is FULLY masked
+    (i*Tk > qpos_max), the streaming state is briefly poisoned by
+    exp(0)=1 tiles and must be wiped by the first real block's
+    correction factor — numerically delicate, so pin it against the
+    oracle (every row attends block j=0, so the wipe always happens)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    q, _, _ = _qkv(seed=7)
+    _, k, v = _qkv(seed=8)
+    q = q[:, : T // 2]  # [B, 32, H, D] -> Tq=4/shard, Tk=8/shard
+
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring.ring_attention_shard(
+                q, k, v, axis_name=DP_AXIS, axis_size=8, causal=True
+            ),
+            mesh=mesh,
+            in_specs=(P(None, DP_AXIS),) * 3,
+            out_specs=P(None, DP_AXIS),
+        )
+    )(q, k, v)
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
