@@ -73,32 +73,58 @@ def full_attention(
 def ring_attention_shard(
     q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
     axis_size: int, causal: bool = False, scale: float | None = None,
+    qpos: jax.Array | None = None, kpos: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis_name``; call
     INSIDE ``shard_map``. Per-shard shapes ``[B, T/P, H, D]``.
 
-    P ring steps; at step r this device holds K/V block ``(i - r) % P``
-    (blocks rotate ``i -> i+1`` via ``ppermute`` — neighbour traffic on
-    ICI). The online-softmax state is carried in fp32 regardless of input
-    dtype; output is cast back to ``q.dtype``.
+    P ring steps; at step r this device holds the K/V block that started
+    on device ``(i - r) % P`` (blocks rotate ``i -> i+1`` via
+    ``ppermute`` — neighbour traffic on ICI). The online-softmax state is
+    carried in fp32 regardless of input dtype; output is cast back to
+    ``q.dtype``.
+
+    ``qpos``/``kpos`` are the ABSOLUTE sequence positions of this shard's
+    rows (int32 ``[Tq]`` / ``[Tk]``; default: contiguous blocks in mesh
+    order). ``kpos`` travels around the ring with its K/V block, so any
+    assignment of positions to devices is supported — striped/two-ended
+    causal layouts that spread the causal triangle's work more evenly
+    just pass their own position arrays. (Tile-granularity skipping
+    cannot fully balance a striped layout — that needs sub-tile updates —
+    so no such layout wrapper is shipped; the capability is the explicit
+    positions.) Causal tiles that are ENTIRELY masked (``min(kpos) >
+    max(qpos)``, checked at runtime per ring step) skip their
+    score/update compute via ``lax.cond``; a skipped-from-the-start state
+    is clean (the first real block's correction factor is
+    exp(_MASKED - m_new) = 0), but every causal query row must attend at
+    least one key (true whenever position 0 is somewhere in ``kpos``'s
+    global set), or its normalization hits 0/0.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     i = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    qpos = i * Tq + jnp.arange(Tq)
+    if qpos is None:
+        qpos = i * Tq + jnp.arange(Tq)
+    if kpos is None:
+        kpos = i * Tk + jnp.arange(Tk)
+    qmax = qpos.max()
 
-    m = jnp.full((B, H, Tq), _MASKED, dtype=jnp.float32)
-    l = jnp.zeros((B, H, Tq), dtype=jnp.float32)
-    acc = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
+    # pcast-to-varying: the init state must carry the mesh axis in its
+    # varying set, or the causal lax.cond rejects identity-vs-update
+    # branches (the identity branch would return the axis-invariant init
+    # while block_update's outputs vary with this device's q/k).
+    vary = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    m = vary(jnp.full((B, H, Tq), _MASKED, dtype=jnp.float32))
+    l = vary(jnp.zeros((B, H, Tq), dtype=jnp.float32))
+    acc = vary(jnp.zeros((B, Tq, H, D), dtype=jnp.float32))
     perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
 
-    def block_update(m, l, acc, k, v, j):
+    def block_update(m, l, acc, k, v, kpos):
         s_tile = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         s_tile = s_tile * scale
         if causal:
-            kpos = j * Tk + jnp.arange(Tk)
             s_tile = jnp.where(
                 kpos[None, :] <= qpos[:, None], s_tile, _MASKED
             )
@@ -112,31 +138,26 @@ def ring_attention_shard(
         return m_new, l, acc
 
     for r in range(axis_size):
-        j = (i - r) % axis_size  # owner of the block currently held
-        if causal and r > 0 and Tk >= Tq:
-            # Blocks strictly in the future (j > i) are ENTIRELY masked
-            # when kpos_min = j*Tk >= qpos_max+1 = i*Tq + Tq, guaranteed
-            # by Tk >= Tq (static check — with Tk < Tq a j > i block can
-            # still hold attended positions and must run the masked
-            # update): skip their score/update compute per device with
-            # lax.cond — the causal sweep does ~half the off-diagonal
-            # block work. r == 0 is the diagonal block (j == i), always
-            # computed. NOTE: the saving is per-device compute (energy /
-            # shared-core throughput); ring steps stay lockstep at the
-            # ppermute, and at every step some device holds an unmasked
-            # block, so wall-clock latency is unchanged — balancing it
-            # needs a striped block layout, out of scope here.
+        if causal:
+            # Entirely-future tiles do no work (runtime check on the
+            # travelling positions — correct for ANY layout, including
+            # Tk != Tq and striped assignments). The saving is per-device
+            # compute; ring steps stay lockstep at the ppermute, so
+            # wall-clock balance depends on the position LAYOUT — the
+            # contiguous default leaves device P-1 computing every step.
             m, l, acc = lax.cond(
-                j > i,
-                lambda m, l, acc, k, v, j: (m, l, acc),
+                kpos.min() > qmax,
+                lambda m, l, acc, k, v, kpos: (m, l, acc),
                 block_update,
-                m, l, acc, k, v, j,
+                m, l, acc, k, v, kpos,
             )
         else:
-            m, l, acc = block_update(m, l, acc, k, v, j)
+            m, l, acc = block_update(m, l, acc, k, v, kpos)
         if r != axis_size - 1:
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)
+            if causal:
+                kpos = lax.ppermute(kpos, axis_name, perm)
     out = acc / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

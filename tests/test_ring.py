@@ -261,3 +261,39 @@ def test_ring_attention_longer_kv_causal():
     )(q, k, v)
     expect = ring.full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
+
+
+def test_ring_attention_custom_striped_positions():
+    """Explicit qpos/kpos: a strided layout (device i holds positions
+    i, i+8, i+16, ...) must reproduce the oracle — pins that kpos
+    genuinely travels the ring with its K/V block and that causal
+    masking/skipping follow the travelling positions, not device order."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    q, k, v = _qkv(seed=9)
+    Pn = 8
+    # Global permutation sending device i's rows to positions i + 8*ar.
+    order = np.arange(T).reshape(T // Pn, Pn).T.reshape(-1)  # [0,8,..,1,9..]
+    inv = np.argsort(order)
+
+    def shard_fn(q, k, v):
+        i = jax.lax.axis_index(DP_AXIS)
+        pos = i + Pn * jnp.arange(T // Pn)
+        return ring.ring_attention_shard(
+            q, k, v, axis_name=DP_AXIS, axis_size=Pn, causal=True,
+            qpos=pos, kpos=pos,
+        )
+
+    out = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(None, DP_AXIS),) * 3, out_specs=P(None, DP_AXIS),
+        )
+    )(q[:, order], k[:, order], v[:, order])
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out)[:, inv], np.asarray(expect), atol=2e-4
+    )
