@@ -35,6 +35,10 @@ VARIANTS = (
     "async_sharding",
     "sync_sharding_greedy",
     "async_sharding_greedy",
+    # Beyond the reference matrix: sequence-parallel LM training (ring /
+    # Ulysses attention over the mesh; strategies/seq.py). The reference
+    # has no sequence axis anywhere (SURVEY.md §5).
+    "lm",
 )
 
 
@@ -63,7 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global batch size (reference default 100; when "
                         "unset, rounded up to a multiple of --num-workers "
                         "so sharded data divides evenly)")
-    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--lr", type=float, default=None,
+                   help="Adam learning rate (default: 1e-4, the reference's "
+                        "model.py:93; lm: 1e-3)")
     p.add_argument("--keep-prob", type=float, default=0.5)
     p.add_argument("--eval-every", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
@@ -131,6 +137,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "tunnel's sitecustomize overrides JAX_PLATFORMS, so "
                         "an env var cannot; '--platform cpu' gives a "
                         "hermetic virtual mesh for CI and smoke runs)")
+    lm = p.add_argument_group(
+        "lm (sequence-parallel) options",
+        "the 'lm' variant trains the decoder LM on the procedural copy "
+        "task with the SEQUENCE axis sharded over the mesh "
+        "(strategies/seq.py); --num-workers is the sequence-parallel "
+        "degree, --batch-size counts sequences (default 32), --epochs/"
+        "--eval-every/--seed/--bf16/--json apply as usual",
+    )
+    lm.add_argument("--seq-scheme", default="ring",
+                    choices=["ring", "ulysses", "full"],
+                    help="cross-shard attention scheme: ring (ppermute "
+                         "K/V rotation), ulysses (all_to_all head "
+                         "re-partition; needs --heads divisible by "
+                         "--num-workers), full (no sharding; W=1 only)")
+    lm.add_argument("--seq-len", type=int, default=512,
+                    help="sequence length (divisible by --num-workers)")
+    lm.add_argument("--vocab", type=int, default=64)
+    lm.add_argument("--d-model", type=int, default=256)
+    lm.add_argument("--heads", type=int, default=8)
+    lm.add_argument("--layers", type=int, default=4)
+    lm.add_argument("--d-ff", type=int, default=1024)
+    lm.add_argument("--train-seqs", type=int, default=2048,
+                    help="procedural copy-task training sequences")
+    lm.add_argument("--test-seqs", type=int, default=256)
+    lm.add_argument("--target-accuracy", type=float, default=None,
+                    help="stop at the first eval reaching this next-token "
+                         "accuracy")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -239,7 +272,7 @@ def config_from_args(args) -> "TrainConfig":
     return TrainConfig(
         epochs=args.epochs,
         batch_size=batch_size,
-        learning_rate=args.lr,
+        learning_rate=args.lr if args.lr is not None else 1e-4,
         keep_prob=args.keep_prob,
         eval_every=args.eval_every,
         seed=args.seed,
@@ -298,6 +331,83 @@ def _ensure_devices(n: int, *, allow_fallback: bool = True,
     print(f"[ddl_tpu] falling back to {len(jax.devices())}-device virtual CPU mesh")
 
 
+def _run_lm(args) -> int:
+    """The ``lm`` variant: sequence-parallel decoder-LM training on the
+    procedural copy task (platform/multihost setup already done by
+    ``main``). Reuses the shared flags; every MNIST-only flag that was
+    changed from its parser default is rejected, so a typo fails loudly
+    instead of silently training without its effect."""
+    defaults = build_parser()
+    for dest in ("num_ps", "layout", "keep_prob", "staleness_seed", "data",
+                 "synthetic_train", "synthetic_test", "fused_adam",
+                 "conv1_matmul", "conv_channels", "fc_sizes", "tiny",
+                 "reference_compat", "checkpoint_dir", "checkpoint_every",
+                 "resume", "dispatch_timeout", "profile"):
+        if getattr(args, dest) != defaults.get_default(dest):
+            raise SystemExit(
+                f"--{dest.replace('_', '-')} does not apply to the lm variant"
+            )
+    from .data.lm import synthesize_copy
+    from .models.transformer import LMSpec
+    from .strategies.seq import SeqConfig, SeqTrainer
+
+    num_workers = args.num_workers or _default_workers(args.variant)
+    if args.multihost:
+        _ensure_devices(num_workers, allow_fallback=False,
+                        reason="use --num-workers <= the world's global "
+                               "device count")
+    else:
+        _ensure_devices(num_workers, allow_fallback=args.platform is None,
+                        reason="drop --platform to allow the "
+                               "virtual-CPU-mesh fallback")
+    spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
+                  num_heads=args.heads, num_layers=args.layers,
+                  d_ff=args.d_ff)
+    cfg = SeqConfig(
+        epochs=args.epochs,
+        batch_size=args.batch_size or 32,
+        learning_rate=args.lr if args.lr is not None else 1e-3,
+        eval_every=args.eval_every,
+        seed=args.seed,
+        num_workers=num_workers,
+        scheme=args.seq_scheme,
+        compute_dtype=_resolve_dtype(args),
+        target_accuracy=args.target_accuracy,
+        spec=spec,
+    )
+    try:
+        dataset = synthesize_copy(
+            num_train=args.train_seqs, num_test=args.test_seqs,
+            seq_len=args.seq_len, vocab=args.vocab, seed=args.seed,
+        )
+        trainer = SeqTrainer(cfg, dataset)
+        result = trainer.train()
+    except ValueError as e:
+        # Config-shaped errors (odd seq_len, tiny vocab, indivisible
+        # shards, batch > dataset) become clean CLI failures; train()
+        # raises ValueError only from its pre-flight batch check.
+        raise SystemExit(f"lm config error: {e}")
+    print(f"training time: {result.train_time_s:.2f}s "
+          f"({result.tokens_per_sec:.0f} tokens/s, "
+          f"compile {result.compile_time_s:.1f}s excluded)")
+    if args.json:
+        print(json.dumps({
+            "variant": "lm",
+            "config": {**dataclasses.asdict(cfg),
+                       "seq_len": args.seq_len,
+                       "train_seqs": args.train_seqs},
+            "final_accuracy": result.final_accuracy,
+            "final_loss": result.final_loss,
+            "history": [[e, b, round(a, 6)] for e, b, a in result.history],
+            "train_time_s": result.train_time_s,
+            "tokens_per_sec": result.tokens_per_sec,
+            "compile_time_s": result.compile_time_s,
+            "step_stats": dataclasses.asdict(result.step_stats)
+                          if result.step_stats else None,
+        }))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform:
@@ -332,6 +442,8 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(f"[ddl_tpu] multihost: process {jax.process_index()}/"
               f"{jax.process_count()}, {len(jax.devices())} global devices")
+    if args.variant == "lm":
+        return _run_lm(args)
     from .data import load_mnist
 
     dataset = load_mnist(

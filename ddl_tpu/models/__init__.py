@@ -1,3 +1,4 @@
+from .transformer import LMSpec, apply_lm, init_lm_params  # noqa: F401
 from .cnn import (
     PARAM_SPECS,
     PARAM_NAMES,
@@ -10,6 +11,9 @@ from .cnn import (
 )
 
 __all__ = [
+    "LMSpec",
+    "apply_lm",
+    "init_lm_params",
     "PARAM_SPECS",
     "PARAM_NAMES",
     "accuracy",

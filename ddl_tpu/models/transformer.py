@@ -1,0 +1,217 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+The reference has no attention and no sequence axis at all (fixed
+784-pixel image inputs, mnist_sync/model/model.py:18-19; SURVEY.md §5
+records sequence parallelism as owed nothing for parity). This family
+exists so the sequence-parallel machinery in ``ddl_tpu.parallel.ring``
+(ring attention over ``ppermute``, Ulysses over ``all_to_all``) is a
+product surface rather than an op library: ``ddl_tpu.strategies.seq``
+trains this model with the sequence dimension sharded across the mesh.
+
+TPU-first design decisions:
+
+- **Pluggable attention**: :func:`apply_lm` takes ``attn_fn(q, k, v)``,
+  so the SAME model code runs single-device (``ring.full_attention``)
+  or per-shard inside ``shard_map`` (``ring.ring_attention_shard`` /
+  ``ring.ulysses_attention_shard``). The model never knows whether its
+  sequence axis is whole or a shard.
+- **RoPE, not a position table**: positions enter as rotations of q/k
+  computed from ABSOLUTE positions (``pos_offset`` + local arange), so a
+  shard holding positions ``[o, o + T/P)`` produces exactly the rotations
+  the full sequence would — K/V blocks travelling around the ring carry
+  their positions baked in. A learned position table would need the same
+  offset plumbing plus a vocab-style lookup; RoPE needs neither state nor
+  gather.
+- **Pre-LN blocks** (LN -> attn -> residual, LN -> MLP -> residual):
+  everything except attention is position-local, so sequence sharding is
+  transparent; the only cross-shard ops in the whole network are inside
+  ``attn_fn``.
+- Matmul-shaped throughout (QKV/O projections, MLP, logits) — the MXU
+  path; ``compute_dtype=jnp.bfloat16`` casts weights/activations while
+  keeping logits/loss fp32, same contract as ``models.cnn``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+Params = Mapping[str, Any]
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class LMSpec:
+    """Architecture of one family member. ``head_dim`` must be even
+    (RoPE rotates dimension pairs)."""
+
+    vocab: int = 256
+    d_model: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    d_ff: int = 1024
+    rope_base: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        if self.d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by "
+                f"{self.num_heads} heads"
+            )
+        return self.d_model // self.num_heads
+
+    def num_params(self) -> int:
+        e, f, v = self.d_model, self.d_ff, self.vocab
+        per_block = 4 * e * e + 2 * e * f + f + e + 4 * e
+        return v * e + self.num_layers * per_block + 2 * e + e * v
+
+
+# Test/dryrun-sized member of the family (same structure, ~1/100 the FLOPs).
+TINY_SPEC = LMSpec(vocab=32, d_model=32, num_heads=2, num_layers=2, d_ff=64)
+
+
+def init_lm_params(
+    key: jax.Array, spec: LMSpec = LMSpec(), dtype=jnp.float32
+) -> dict[str, Any]:
+    """Glorot-uniform projections (matching ``cnn.init_params``' TF1
+    default), unit LN gains, zero biases, output head included (untied)."""
+
+    def glorot(k, shape):
+        limit = math.sqrt(6.0 / (shape[0] + shape[-1]))
+        return jax.random.uniform(k, shape, dtype, -limit, limit)
+
+    e, f = spec.d_model, spec.d_ff
+    keys = iter(jax.random.split(key, 2 + 6 * spec.num_layers))
+    blocks = []
+    for _ in range(spec.num_layers):
+        blocks.append({
+            "ln1_g": jnp.ones((e,), dtype), "ln1_b": jnp.zeros((e,), dtype),
+            "wq": glorot(next(keys), (e, e)),
+            "wk": glorot(next(keys), (e, e)),
+            "wv": glorot(next(keys), (e, e)),
+            "wo": glorot(next(keys), (e, e)),
+            "ln2_g": jnp.ones((e,), dtype), "ln2_b": jnp.zeros((e,), dtype),
+            "w1": glorot(next(keys), (e, f)), "b1": jnp.zeros((f,), dtype),
+            "w2": glorot(next(keys), (f, e)), "b2": jnp.zeros((e,), dtype),
+        })
+    return {
+        "embed": glorot(next(keys), (spec.vocab, e)),
+        "blocks": blocks,
+        "lnf_g": jnp.ones((e,), dtype), "lnf_b": jnp.zeros((e,), dtype),
+        "head": glorot(next(keys), (e, spec.vocab)),
+    }
+
+
+def _layernorm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
+    # fp32 statistics regardless of compute dtype (bf16 variance underflows).
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g + b
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float) -> jax.Array:
+    """Rotate dimension pairs of ``x [B, T, H, D]`` by angles
+    ``positions[t] * base**(-2i/D)``. ``positions [T]`` are ABSOLUTE —
+    a sequence shard passes ``offset + arange(T_local)`` and gets exactly
+    the rotations its positions would receive in the full sequence."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"head_dim {d} must be even for RoPE")
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions.astype(jnp.float32)[:, None] * freqs  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def apply_lm(
+    params: Params,
+    tokens: jax.Array,
+    spec: LMSpec = LMSpec(),
+    *,
+    attn_fn: AttnFn,
+    pos_offset: int | jax.Array = 0,
+    compute_dtype=None,
+) -> jax.Array:
+    """Forward pass: int tokens ``[B, T]`` -> fp32 logits ``[B, T, vocab]``.
+
+    ``T`` may be the full sequence or a shard of it; ``pos_offset`` is the
+    absolute position of element 0 (a traced ``lax.axis_index`` expression
+    under ``shard_map``). ``attn_fn`` performs (possibly cross-shard)
+    attention on post-RoPE ``[B, T, H, D]`` q/k/v and owns causal masking —
+    the model applies no mask itself.
+    """
+    if compute_dtype is not None:
+        params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
+    h = params["embed"][tokens]  # [B, T, E]
+    b, t, e = h.shape
+    positions = pos_offset + jnp.arange(t)
+    heads = lambda a: a.reshape(b, t, spec.num_heads, spec.head_dim)
+    for blk in params["blocks"]:
+        x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+        q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
+        k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
+        v = heads(x @ blk["wv"])
+        h = h + attn_fn(q, k, v).reshape(b, t, e) @ blk["wo"]
+        x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+        h = h + jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"] + blk["b2"]
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    return (h @ params["head"]).astype(jnp.float32)
+
+
+def lm_loss_sums(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    weights: jax.Array,
+    spec: LMSpec = LMSpec(),
+    *,
+    attn_fn: AttnFn,
+    pos_offset: int | jax.Array = 0,
+    compute_dtype=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Weighted next-token cross-entropy as ``(sum_ce, sum_weights)`` —
+    the accumulator form, so the caller owns normalization: a single
+    device divides directly; a sequence shard ``psum``s both over the
+    mesh axis first (mean of per-shard means would be wrong whenever the
+    loss mask is unevenly distributed across shards, as it is for the
+    copy task where only second-half positions are scored)."""
+    logits = apply_lm(
+        params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
+        compute_dtype=compute_dtype,
+    )
+    logprobs = jax.nn.log_softmax(logits)
+    ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    return jnp.sum(ce * w), jnp.sum(w)
+
+
+def lm_correct_sums(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    weights: jax.Array,
+    spec: LMSpec = LMSpec(),
+    *,
+    attn_fn: AttnFn,
+    pos_offset: int | jax.Array = 0,
+    compute_dtype=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Weighted top-1 next-token hits as ``(sum_correct, sum_weights)``
+    (accumulator form, same contract as :func:`lm_loss_sums` — and the
+    analogue of ``cnn.correct_count``)."""
+    logits = apply_lm(
+        params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
+        compute_dtype=compute_dtype,
+    )
+    hits = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(hits * w), jnp.sum(w)

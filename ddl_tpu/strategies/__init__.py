@@ -10,7 +10,12 @@ as strategy configs over one codebase (SURVEY.md §7 design stance).
 | mnist_async_sharding           | ``AsyncTrainer`` + layout="block"            |
 | mnist_async_sharding_greedy    | ``AsyncTrainer`` + layout="zigzag"/"lpt"     |
 | */single.py                    | ``ddl_tpu.train.SingleChipTrainer``          |
+
+Beyond the reference matrix: ``SeqTrainer`` (strategies/seq.py) trains the
+decoder LM with the SEQUENCE axis sharded over the mesh (ring attention /
+Ulysses) — the long-context strategy; the reference has no sequence axis.
 """
 
+from .seq import SeqConfig, SeqTrainer  # noqa: F401
 from .sync import SyncTrainer, make_dp_step, make_sharded_step  # noqa: F401
 from .async_ps import AsyncTrainer, make_async_round, async_schedule  # noqa: F401

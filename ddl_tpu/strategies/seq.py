@@ -1,0 +1,320 @@
+"""Sequence/context-parallel training: the long-context strategy.
+
+The reference's strategy matrix stops at data parallelism and parameter
+sharding (SURVEY.md §2.3; it has no sequence axis anywhere —
+mnist_sync/model/model.py:18-19). This strategy goes beyond that matrix:
+it trains the decoder-only LM (``models.transformer``) with the SEQUENCE
+dimension sharded across the mesh, so context length scales past one
+chip's HBM — the batch stays whole on every device and each device holds
+``T / W`` positions of every sequence.
+
+Scheme selection (``SeqConfig.scheme``):
+
+- ``ring``    — ring attention: K/V blocks rotate via ``lax.ppermute``
+  over ICI neighbour links; exact streaming-softmax attention with
+  O(T/W * T/W) score memory per device (``ring.ring_attention_shard``).
+- ``ulysses`` — two ``lax.all_to_all``s re-partition sequence-sharded
+  activations to head-sharded and back; needs ``num_heads % W == 0``.
+- ``full``    — no cross-shard attention (W=1 only): the single-device
+  oracle the parity tests compare against.
+
+Everything outside ``attn_fn`` is position-local, so the ONLY cross-shard
+communication per step is inside attention plus one gradient ``psum``
+(inserted automatically by ``shard_map``'s transpose for the replicated
+param cotangents) and the scalar loss normalization ``psum`` — there is
+deliberately no parameter sharding here; compose with ZeRO-1 by taking
+``strategies.sync``'s sharded update if params ever outgrow HBM.
+
+Same training machinery as the other strategies: device-resident
+``eval_spans`` span programs (AOT-compiled), ``StepTimer`` percentiles,
+``--target-accuracy`` early stop, deterministic seeded init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..data.lm import LMDataset
+from ..models import transformer
+from ..models.transformer import LMSpec
+from ..ops import adam_init, adam_update
+from ..parallel import ring
+from ..parallel.mesh import DP_AXIS, make_mesh
+from ..train.trainer import eval_spans, force, steps_scan
+from ..utils.metrics import StepStats, StepTimer
+
+Scheme = Literal["ring", "ulysses", "full"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqConfig:
+    epochs: int = 1
+    batch_size: int = 8  # sequences per global batch (batch is NOT sharded)
+    learning_rate: float = 1e-3
+    eval_every: int = 10  # batches between test-set evals (0 = end only)
+    seed: int = 0
+    num_workers: int = 1  # sequence-parallel degree (mesh axis size)
+    scheme: Scheme = "ring"
+    compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
+    target_accuracy: float | None = None
+    spec: LMSpec = LMSpec()
+
+    def dtype(self):
+        return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
+
+
+@dataclasses.dataclass
+class LMResult:
+    params: dict
+    final_accuracy: float  # weighted next-token accuracy on the test set
+    final_loss: float
+    wall_time_s: float
+    train_time_s: float  # span dispatch only; evals and compilation excluded
+    history: list[tuple[int, int, float]]  # (epoch, batch, accuracy)
+    tokens_per_sec: float  # scored + unscored tokens (B * T) / train_time_s
+    compile_time_s: float = 0.0
+    step_stats: StepStats | None = None
+
+
+def _attn_for(config: SeqConfig):
+    """The per-shard attention closure for this config — always causal
+    (decoder LM). ``full`` is the W=1 oracle; ring/ulysses derive their
+    absolute positions from ``lax.axis_index`` inside the shard."""
+    W = config.num_workers
+    if config.scheme == "full":
+        if W != 1:
+            raise ValueError("scheme='full' cannot shard the sequence; "
+                             "use ring or ulysses for num_workers > 1")
+        return functools.partial(ring.full_attention, causal=True)
+    if config.scheme == "ring":
+        return functools.partial(
+            ring.ring_attention_shard, axis_name=DP_AXIS, axis_size=W,
+            causal=True,
+        )
+    if config.scheme == "ulysses":
+        return functools.partial(
+            ring.ulysses_attention_shard, axis_name=DP_AXIS, axis_size=W,
+            causal=True,
+        )
+    raise ValueError(f"unknown scheme {config.scheme!r}")
+
+
+def _shard_sums(config: SeqConfig, fn):
+    """Per-shard ``(global_num, global_den)`` for an accumulator-form
+    metric ``fn`` (``lm_loss_sums`` / ``lm_correct_sums``): local sums
+    over this shard's ``T/W`` positions, ``psum``med over the mesh axis.
+    Global-mean-of-sums, NOT mean-of-shard-means — the loss mask is
+    concentrated in the sequence's second half, so shards hold unequal
+    scored-token counts (data.lm module docstring)."""
+    attn = _attn_for(config)
+
+    def sums(params, tokens, targets, weights):
+        t_local = tokens.shape[1]
+        offset = lax.axis_index(DP_AXIS) * t_local
+        num, den = fn(
+            params, tokens, targets, weights, config.spec, attn_fn=attn,
+            pos_offset=offset, compute_dtype=config.dtype(),
+        )
+        return lax.psum(num, DP_AXIS), lax.psum(den, DP_AXIS)
+
+    return sums
+
+
+def _step_body(config: SeqConfig):
+    """One train step, already inside ``shard_map``: global weighted-CE
+    loss, grads for the replicated params (``shard_map`` transposes the
+    replicated in_spec with an automatic cotangent ``psum`` — the pattern
+    pinned against the oracle by tests/test_lm.py), TF1-Adam update."""
+    loss_sums = _shard_sums(config, transformer.lm_loss_sums)
+
+    def loss(params, tokens, targets, weights):
+        num, den = loss_sums(params, tokens, targets, weights)
+        return num / den
+
+    def step(params, opt_state, tokens, targets, weights):
+        l, grads = jax.value_and_grad(loss)(params, tokens, targets, weights)
+        params, opt_state = adam_update(
+            params, opt_state, grads, lr=config.learning_rate
+        )
+        return params, opt_state, l
+
+    return step
+
+
+class SeqTrainer:
+    """Sequence-parallel LM trainer over a 1-D mesh.
+
+    Data placement: token/target/weight batches ``[nb, B, T]`` sharded
+    ``P(None, None, dp)`` — every device holds all sequences but only its
+    ``T/W`` window of each; params and optimizer state replicated."""
+
+    def __init__(self, config: SeqConfig, dataset: LMDataset):
+        W = config.num_workers
+        if dataset.seq_len % max(W, 1):
+            raise ValueError(
+                f"seq_len {dataset.seq_len} not divisible by {W} workers"
+            )
+        if config.scheme == "ulysses" and config.spec.num_heads % max(W, 1):
+            raise ValueError(
+                f"ulysses needs num_heads ({config.spec.num_heads}) "
+                f"divisible by num_workers ({W})"
+            )
+        if dataset.tokens.max() >= config.spec.vocab:
+            raise ValueError(
+                f"dataset vocab {dataset.tokens.max() + 1} exceeds model "
+                f"vocab {config.spec.vocab}"
+            )
+        _attn_for(config)  # fail fast: unknown scheme / full-with-sharding
+        self.config = config
+        self.dataset = dataset
+        self.mesh = make_mesh(W)
+        self.params = jax.device_put(
+            transformer.init_lm_params(
+                jax.random.PRNGKey(config.seed), config.spec
+            ),
+            NamedSharding(self.mesh, P()),
+        )
+        self.opt_state = jax.device_put(
+            adam_init(self.params), NamedSharding(self.mesh, P())
+        )
+
+    # -- compiled programs -------------------------------------------------
+
+    def _seq_sharding(self, ndim: int) -> NamedSharding:
+        spec = [None] * (ndim - 1) + [DP_AXIS]
+        return NamedSharding(self.mesh, P(*spec))
+
+    def _span_fn(self, k: int):
+        """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
+        ``k`` consecutive batches as ONE device-resident program
+        (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``)."""
+        step = _step_body(self.config)
+        shard_step = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(None, DP_AXIS), P(None, DP_AXIS),
+                      P(None, DP_AXIS)),
+            out_specs=(P(), P(), P()),
+        )
+
+        def run(params, opt_state, xs, ys, ws, first):
+            def body(carry, i):
+                p, o = carry
+                p, o, l = shard_step(p, o, xs[i], ys[i], ws[i])
+                return (p, o), l
+
+            (params, opt_state), losses = steps_scan(
+                body, (params, opt_state), first + jnp.arange(k), k
+            )
+            return params, opt_state, losses[-1]
+
+        return jax.jit(run)
+
+    def _eval_fn(self):
+        sums = jax.shard_map(
+            _shard_sums(self.config, transformer.lm_correct_sums),
+            mesh=self.mesh,
+            in_specs=(P(), P(None, DP_AXIS), P(None, DP_AXIS),
+                      P(None, DP_AXIS)),
+            out_specs=(P(), P()),
+        )
+
+        def acc(params, tokens, targets, weights):
+            num, den = sums(params, tokens, targets, weights)
+            return num / den
+
+        return jax.jit(acc)
+
+    def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
+        shaped = arr[: batches * bs].reshape(batches, bs, arr.shape[1])
+        return jax.device_put(shaped, self._seq_sharding(3))
+
+    # -- training ----------------------------------------------------------
+
+    def train(self, log=print) -> LMResult:
+        cfg = self.config
+        ds = self.dataset
+        bs = cfg.batch_size
+        batch_num = ds.num_train // bs
+        if batch_num == 0:
+            raise ValueError(
+                f"batch_size {bs} exceeds {ds.num_train} train sequences"
+            )
+        xs = self._stage(ds.tokens, batch_num, bs)
+        ys = self._stage(ds.targets, batch_num, bs)
+        ws = self._stage(ds.weights, batch_num, bs)
+        xte = jax.device_put(ds.test_tokens, self._seq_sharding(2))
+        yte = jax.device_put(ds.test_targets, self._seq_sharding(2))
+        wte = jax.device_put(ds.test_weights, self._seq_sharding(2))
+        params, opt_state = self.params, self.opt_state
+        force((xs, ys, ws, xte, yte, wte, params, opt_state), all_leaves=True)
+
+        spans = eval_spans(batch_num, cfg.eval_every)
+        t0 = time.perf_counter()
+        fns = {
+            k: self._span_fn(k)
+            .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
+            .compile()
+            for k in {k for _, k, _ in spans}
+        }
+        ev = self._eval_fn().lower(params, xte, yte, wte).compile()
+        compile_time = time.perf_counter() - t0
+
+        timer = StepTimer()
+        history: list[tuple[int, int, float]] = []
+        accuracy = float("nan")
+        loss = float("nan")
+        tokens_per_batch = bs * ds.seq_len
+        hit = False
+        epoch = 0  # epochs=0: eval-only run (the loop never binds it)
+        start = time.perf_counter()
+        for epoch in range(cfg.epochs):
+            for first, k, eval_after in spans:
+                with timer.step(images=k * tokens_per_batch):
+                    params, opt_state, l = fns[k](
+                        params, opt_state, xs, ys, ws, jnp.int32(first)
+                    )
+                    loss = float(l)  # barrier: host fetch of the span loss
+                if eval_after:
+                    accuracy = float(ev(params, xte, yte, wte))
+                    history.append((epoch, first + k - 1, accuracy))
+                    log(
+                        f"epoch {epoch} batch {first + k - 1} "
+                        f"loss {loss:.4f} test_accuracy {accuracy:.4f}"
+                    )
+                    if (cfg.target_accuracy is not None
+                            and accuracy >= cfg.target_accuracy):
+                        hit = True
+                        break
+            if hit:
+                break
+        wall = time.perf_counter() - start
+
+        if not (history and history[-1][:2] == (epoch, batch_num - 1)) and not hit:
+            accuracy = float(ev(params, xte, yte, wte))
+            history.append((epoch, batch_num - 1, accuracy))
+        stats = timer.stats()
+        log(
+            f"final test_accuracy {accuracy:.4f} loss {loss:.4f} "
+            f"({stats.images_per_sec:.0f} tokens/s)"
+        )
+        return LMResult(
+            params=jax.device_get(params),
+            final_accuracy=accuracy,
+            final_loss=loss,
+            wall_time_s=wall,
+            train_time_s=stats.total_s,
+            history=history,
+            tokens_per_sec=stats.images_per_sec,
+            compile_time_s=compile_time,
+            step_stats=stats,
+        )

@@ -7,6 +7,7 @@ import json
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 from ddl_tpu.cli import build_parser, config_from_args, main
@@ -119,6 +120,29 @@ def test_main_end_to_end(variant, capsys):
     payload = _run_main(argv, capsys)
     assert payload["variant"] == variant
     assert payload["config"]["conv_channels"] == [4, 8, 8, 8]
+
+
+def test_main_lm_end_to_end(capsys):
+    """The lm variant (sequence-parallel decoder LM, strategies/seq.py)
+    trains end-to-end through main() on the 8-device mesh: ring attention
+    over the copy task, JSON contract with tokens_per_sec."""
+    payload = _run_main([
+        "lm", "--num-workers", "8", "--seq-len", "32", "--vocab", "16",
+        "--d-model", "32", "--heads", "2", "--layers", "2", "--d-ff", "64",
+        "--train-seqs", "64", "--test-seqs", "16", "--batch-size", "16",
+        "--eval-every", "2", "--json",
+    ], capsys, expect_steps=False)
+    assert payload["variant"] == "lm"
+    assert payload["config"]["scheme"] == "ring"
+    assert payload["tokens_per_sec"] > 0
+    assert np.isfinite(payload["final_loss"])
+
+
+def test_main_lm_rejects_mnist_only_flags(capsys):
+    with pytest.raises(SystemExit, match="--tiny"):
+        main(["lm", "--tiny"])
+    with pytest.raises(SystemExit, match="--fused-adam"):
+        main(["lm", "--fused-adam"])
 
 
 def test_main_reference_compat_end_to_end(capsys):
