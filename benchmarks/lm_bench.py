@@ -1,0 +1,142 @@
+"""Long-context LM training throughput on the real chip.
+
+Times the PRODUCT sequence-parallel span program (``SeqTrainer._span_fn``
+— the same compiled object ``python -m ddl_tpu lm`` dispatches) at a
+sweep of sequence lengths on a 1-chip mesh, bf16, with bench.py's
+methodology: AOT compile outside the bracket, repeats of whole-span
+dispatches, every bracket closed by a host fetch (the tunnel backend
+defers execution until a fetch — BASELINE.md "measurement integrity").
+
+Reports tokens/s and an analytic MFU: train FLOPs/token =
+``6*P_mat + 6*L*T_eff*d`` with ``T_eff = T/2`` (causal), where ``P_mat``
+counts matmul parameters (blocks + output head; the embedding gather is
+not a matmul). One chip has no sequence to shard (scheme=full — the
+oracle kernel); the cross-chip schemes' *program structure* is covered
+by the virtual-mesh scaling proxy and tests/test_ring.py, and their
+memory law (O(T/P * T/P) scores/device) by
+test_ring_attention_memory_is_blockwise.
+
+    python benchmarks/lm_bench.py --json benchmarks/results/lm_tpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def flops_per_token(spec, seq_len: int) -> float:
+    """Train FLOPs/token, PaLM-style accounting: 6 (fwd+bwd) per matmul
+    param, plus attention's two score matmuls (QK^T and AV — each
+    2*T_eff*e fwd per token, x3 for fwd+bwd) at causal T_eff = T/2."""
+    e, f, L = spec.d_model, spec.d_ff, spec.num_layers
+    p_mat = L * (4 * e * e + 2 * e * f) + e * spec.vocab
+    return 6.0 * p_mat + 12.0 * L * (seq_len / 2.0) * e
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-lens", type=int, nargs="+",
+                    default=[512, 1024, 2048, 4096])
+    ap.add_argument("--tokens-per-batch", type=int, default=8192,
+                    help="global batch in tokens; sequences/batch = this // T")
+    ap.add_argument("--span", type=int, default=8,
+                    help="train steps per dispatched span program")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--json", type=str, default=None)
+    args = ap.parse_args()
+
+    from ddl_tpu.parallel.mesh import wait_backend
+
+    # Same bounded-retry probing as bench.py (subprocess probes; a wedged
+    # in-process handshake could never be retried).
+    window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 1200))
+    if not wait_backend(
+        window_s, log=lambda m: print(f"[lm_bench] {m}", file=sys.stderr)
+    ):
+        print(json.dumps({"metric": "lm_train_tokens_per_sec",
+                          "error": "backend unreachable"}))
+        sys.exit(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from ddl_tpu.data.lm import synthesize_copy
+    from ddl_tpu.models.transformer import LMSpec
+    from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+    from ddl_tpu.train.trainer import force
+
+    spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
+                  num_heads=args.heads, num_layers=args.layers,
+                  d_ff=args.d_ff)
+    platform = jax.devices()[0].platform
+    peak = bench._chip_peak_flops()
+    rows = {}
+    for T in args.seq_lens:
+        B = max(1, args.tokens_per_batch // T)
+        k = args.span
+        ds = synthesize_copy(num_train=B * k, num_test=B, seq_len=T,
+                             vocab=args.vocab, seed=0)
+        cfg = SeqConfig(num_workers=1, scheme="full",
+                        compute_dtype="bfloat16", batch_size=B, spec=spec)
+        tr = SeqTrainer(cfg, ds)
+        xs = tr._stage(ds.tokens, k, B)
+        ys = tr._stage(ds.targets, k, B)
+        ws = tr._stage(ds.weights, k, B)
+        params, opt = tr.params, tr.opt_state
+        force((xs, ys, ws, params, opt), all_leaves=True)
+        t0 = time.perf_counter()
+        fn = (tr._span_fn(k)
+              .lower(params, opt, xs, ys, ws, jnp.int32(0)).compile())
+        compile_s = time.perf_counter() - t0
+        params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
+        force((params, opt, loss))  # warmup barrier
+        tps = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
+            force((params, opt, loss))  # true barrier: host fetch
+            tps.append(k * B * T / (time.perf_counter() - t0))
+        best, med = float(max(tps)), float(np.median(tps))
+        mfu = (round(100.0 * best * flops_per_token(spec, T) / peak, 2)
+               if peak else None)
+        rows[T] = {
+            "seqs_per_batch": B, "best_tokens_per_s": round(best, 1),
+            "median_tokens_per_s": round(med, 1), "mfu_pct": mfu,
+            "compile_s": round(compile_s, 1),
+        }
+        print(f"[lm_bench] T={T} B={B}: best {best:,.0f} tok/s "
+              f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
+
+    out = {
+        "metric": "lm_train_tokens_per_sec",
+        "platform": platform,
+        "spec": {"d_model": spec.d_model, "heads": spec.num_heads,
+                 "layers": spec.num_layers, "d_ff": spec.d_ff,
+                 "vocab": spec.vocab,
+                 "params": spec.num_params()},
+        "span_steps": args.span,
+        "results": rows,
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -331,6 +331,40 @@ def _ensure_devices(n: int, *, allow_fallback: bool = True,
     print(f"[ddl_tpu] falling back to {len(jax.devices())}-device virtual CPU mesh")
 
 
+def _install_sigterm_flag(enabled: bool) -> dict:
+    """Graceful preemption (preemptible TPU VMs send SIGTERM before
+    reclaim): finish the in-flight span, save the rolling checkpoint,
+    exit 0 — a later --resume run continues where this one stopped.
+    Returns the flag dict the trainer's ``should_stop`` closes over."""
+    term = {"flag": False}
+    if enabled:
+        import signal
+
+        def _on_term(signum, frame):
+            # Flag only — no IO in the handler (a print here can hit
+            # CPython's reentrant-BufferedWriter guard and kill the run
+            # uncheckpointed). Restoring SIG_DFL lets a second SIGTERM
+            # terminate promptly if the grace window is too short.
+            term["flag"] = True
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    return term
+
+
+def _fatal_timeout(e) -> "int":
+    """AcceleratorTimeout exit: the watchdogged fetch is still wedged in
+    native code; a normal exit would re-enter the dead backend via
+    atexit/PJRT destructors and hang anyway — report, flush, and leave
+    (the AcceleratorTimeout contract, parallel/mesh.py)."""
+    print(f"[ddl_tpu] FATAL: {e}", file=sys.stderr)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    import os
+
+    os._exit(1)
+
+
 def _run_lm(args) -> int:
     """The ``lm`` variant: sequence-parallel decoder-LM training on the
     procedural copy task (platform/multihost setup already done by
@@ -341,12 +375,13 @@ def _run_lm(args) -> int:
     for dest in ("num_ps", "layout", "keep_prob", "staleness_seed", "data",
                  "synthetic_train", "synthetic_test", "fused_adam",
                  "conv1_matmul", "conv_channels", "fc_sizes", "tiny",
-                 "reference_compat", "checkpoint_dir", "checkpoint_every",
-                 "resume", "dispatch_timeout", "profile"):
+                 "reference_compat"):
         if getattr(args, dest) != defaults.get_default(dest):
             raise SystemExit(
                 f"--{dest.replace('_', '-')} does not apply to the lm variant"
             )
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
     from .data.lm import synthesize_copy
     from .models.transformer import LMSpec
     from .strategies.seq import SeqConfig, SeqTrainer
@@ -375,18 +410,30 @@ def _run_lm(args) -> int:
         target_accuracy=args.target_accuracy,
         spec=spec,
     )
+    from .parallel.mesh import AcceleratorTimeout
+
+    term = _install_sigterm_flag(bool(args.checkpoint_dir))
     try:
         dataset = synthesize_copy(
             num_train=args.train_seqs, num_test=args.test_seqs,
             seq_len=args.seq_len, vocab=args.vocab, seed=args.seed,
         )
         trainer = SeqTrainer(cfg, dataset)
-        result = trainer.train()
+        result = trainer.train(
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            profile_dir=args.profile,
+            should_stop=lambda: term["flag"],
+            dispatch_timeout=args.dispatch_timeout,
+        )
     except ValueError as e:
         # Config-shaped errors (odd seq_len, tiny vocab, indivisible
         # shards, batch > dataset) become clean CLI failures; train()
         # raises ValueError only from its pre-flight batch check.
         raise SystemExit(f"lm config error: {e}")
+    except AcceleratorTimeout as e:
+        return _fatal_timeout(e)
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.tokens_per_sec:.0f} tokens/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")
@@ -404,6 +451,8 @@ def _run_lm(args) -> int:
             "compile_time_s": result.compile_time_s,
             "step_stats": dataclasses.asdict(result.step_stats)
                           if result.step_stats else None,
+            "resumed_from_step": result.resumed_from_step,
+            "preempted": result.preempted,
         }))
     return 0
 
@@ -485,22 +534,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
     from .parallel.mesh import AcceleratorTimeout
-    # Graceful preemption (preemptible TPU VMs send SIGTERM before
-    # reclaim): finish the in-flight span, save the rolling checkpoint,
-    # exit 0 — a later --resume run continues where this one stopped.
-    term = {"flag": False}
-    if args.checkpoint_dir:
-        import signal
 
-        def _on_term(signum, frame):
-            # Flag only — no IO in the handler (a print here can hit
-            # CPython's reentrant-BufferedWriter guard and kill the run
-            # uncheckpointed). Restoring SIG_DFL lets a second SIGTERM
-            # terminate promptly if the grace window is too short.
-            term["flag"] = True
-            signal.signal(signal.SIGTERM, signal.SIG_DFL)
-
-        signal.signal(signal.SIGTERM, _on_term)
+    term = _install_sigterm_flag(bool(args.checkpoint_dir))
     try:
         result = trainer.train(
             checkpoint_dir=args.checkpoint_dir,
@@ -511,16 +546,7 @@ def main(argv: list[str] | None = None) -> int:
             dispatch_timeout=args.dispatch_timeout,
         )
     except AcceleratorTimeout as e:
-        # The watchdogged fetch is still wedged in native code; a normal
-        # exit would re-enter the dead backend via atexit/PJRT destructors
-        # and hang anyway — report, flush, and leave (the AcceleratorTimeout
-        # contract, parallel/mesh.py).
-        print(f"[ddl_tpu] FATAL: {e}", file=sys.stderr)
-        sys.stderr.flush()
-        sys.stdout.flush()
-        import os
-
-        os._exit(1)
+        return _fatal_timeout(e)
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")

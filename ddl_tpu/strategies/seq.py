@@ -49,8 +49,20 @@ from ..models.transformer import LMSpec
 from ..ops import adam_init, adam_update
 from ..parallel import ring
 from ..parallel.mesh import DP_AXIS, make_mesh
-from ..train.trainer import eval_spans, force, steps_scan
-from ..utils.metrics import StepStats, StepTimer
+from ..train.trainer import (
+    check_preempt,
+    checkpoint_file,
+    eval_spans,
+    force,
+    guarded,
+    hit_target,
+    resume_plan,
+    save_crossed,
+    steps_scan,
+    try_resume,
+)
+from ..utils.checkpoint import save_checkpoint
+from ..utils.metrics import StepStats, StepTimer, trace
 
 Scheme = Literal["ring", "ulysses", "full"]
 
@@ -83,6 +95,8 @@ class LMResult:
     tokens_per_sec: float  # scored + unscored tokens (B * T) / train_time_s
     compile_time_s: float = 0.0
     step_stats: StepStats | None = None
+    resumed_from_step: int = 0  # global batch restored from a checkpoint
+    preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
 
 
 def _attn_for(config: SeqConfig):
@@ -240,7 +254,24 @@ class SeqTrainer:
 
     # -- training ----------------------------------------------------------
 
-    def train(self, log=print) -> LMResult:
+    def train(
+        self,
+        log=print,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        profile_dir: str | None = None,
+        should_stop=None,
+        dispatch_timeout: float = 0.0,
+    ) -> LMResult:
+        """Same persistence/observability contract as every other trainer:
+        atomic rolling checkpoint at epoch ends (plus every
+        ``checkpoint_every`` batches), cross-cadence elastic resume via
+        ``resume_plan``, graceful preemption through ``check_preempt``,
+        ``dispatch_timeout`` accelerator-death watchdog, ``jax.profiler``
+        trace under ``profile_dir``. The LM step has no RNG (no dropout),
+        so a resumed run is bit-identical to an uninterrupted one."""
         cfg = self.config
         ds = self.dataset
         bs = cfg.batch_size
@@ -256,15 +287,32 @@ class SeqTrainer:
         yte = jax.device_put(ds.test_targets, self._seq_sharding(2))
         wte = jax.device_put(ds.test_weights, self._seq_sharding(2))
         params, opt_state = self.params, self.opt_state
-        force((xs, ys, ws, xte, yte, wte, params, opt_state), all_leaves=True)
+        ckpt = checkpoint_file(checkpoint_dir)
+        tree, start_step = try_resume(
+            ckpt, resume, {"params": params, "opt": opt_state}, log
+        )
+        if tree is not None:
+            rep = NamedSharding(self.mesh, P())
+            params = jax.device_put(tree["params"], rep)
+            opt_state = jax.device_put(tree["opt"], rep)
+        guarded(
+            lambda: force(
+                (xs, ys, ws, xte, yte, wte, params, opt_state),
+                all_leaves=True,
+            ),
+            dispatch_timeout, "train-set staging",
+        )
 
         spans = eval_spans(batch_num, cfg.eval_every)
+        resume_epoch, resume_spans = resume_plan(
+            start_step, batch_num, cfg.eval_every, spans
+        )
         t0 = time.perf_counter()
         fns = {
             k: self._span_fn(k)
             .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
             .compile()
-            for k in {k for _, k, _ in spans}
+            for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}
         }
         ev = self._eval_fn().lower(params, xte, yte, wte).compile()
         compile_time = time.perf_counter() - t0
@@ -274,34 +322,72 @@ class SeqTrainer:
         accuracy = float("nan")
         loss = float("nan")
         tokens_per_batch = bs * ds.seq_len
-        hit = False
+        hit = preempted = False
         epoch = 0  # epochs=0: eval-only run (the loop never binds it)
+        span_idx = 0
         start = time.perf_counter()
-        for epoch in range(cfg.epochs):
-            for first, k, eval_after in spans:
-                with timer.step(images=k * tokens_per_batch):
-                    params, opt_state, l = fns[k](
-                        params, opt_state, xs, ys, ws, jnp.int32(first)
+        with trace(profile_dir):
+            for epoch in range(cfg.epochs):
+                for first, k, eval_after in (
+                    resume_spans if epoch == resume_epoch else spans
+                ):
+                    gstep = epoch * batch_num + first
+                    if gstep < start_step:
+                        continue  # already done by the resumed run
+                    span_idx += 1
+                    with timer.step(images=k * tokens_per_batch):
+                        params, opt_state, l = fns[k](
+                            params, opt_state, xs, ys, ws, jnp.int32(first)
+                        )
+                        # barrier: host fetch of the span loss (the whole
+                        # span chain executes to produce it)
+                        loss = guarded(
+                            lambda: float(l), dispatch_timeout,
+                            f"span dispatch at global batch {gstep}",
+                        )
+                    if eval_after:
+                        accuracy = guarded(
+                            lambda: float(ev(params, xte, yte, wte)),
+                            dispatch_timeout,
+                            f"eval after batch {first + k - 1}",
+                        )
+                        history.append((epoch, first + k - 1, accuracy))
+                        log(
+                            f"epoch {epoch} batch {first + k - 1} "
+                            f"loss {loss:.4f} test_accuracy {accuracy:.4f}"
+                        )
+                        # hit_target duck-types on .target_accuracy, which
+                        # SeqConfig shares with TrainConfig.
+                        hit = hit_target(cfg, accuracy)
+                    preempted = preempted or check_preempt(
+                        should_stop, log, ckpt is not None, span_idx
                     )
-                    loss = float(l)  # barrier: host fetch of the span loss
-                if eval_after:
-                    accuracy = float(ev(params, xte, yte, wte))
-                    history.append((epoch, first + k - 1, accuracy))
-                    log(
-                        f"epoch {epoch} batch {first + k - 1} "
-                        f"loss {loss:.4f} test_accuracy {accuracy:.4f}"
-                    )
-                    if (cfg.target_accuracy is not None
-                            and accuracy >= cfg.target_accuracy):
-                        hit = True
+                    if ckpt and save_crossed(
+                        gstep, k, checkpoint_every,
+                        first + k == batch_num or hit or preempted,
+                    ):
+                        save_checkpoint(
+                            ckpt, {"params": params, "opt": opt_state},
+                            step=gstep + k, extra={"epoch": epoch},
+                        )
+                    if hit or preempted:
                         break
-            if hit:
-                break
+                if hit:
+                    log(f"target accuracy {cfg.target_accuracy} reached")
+                if hit or preempted:
+                    break
         wall = time.perf_counter() - start
 
         if not (history and history[-1][:2] == (epoch, batch_num - 1)) and not hit:
-            accuracy = float(ev(params, xte, yte, wte))
-            history.append((epoch, batch_num - 1, accuracy))
+            accuracy = guarded(
+                lambda: float(ev(params, xte, yte, wte)),
+                dispatch_timeout, "final eval",
+            )
+            if not preempted:
+                # A preempted run's history must not claim an eval point
+                # after batches that never trained; final_accuracy still
+                # reports the stopped state.
+                history.append((epoch, batch_num - 1, accuracy))
         stats = timer.stats()
         log(
             f"final test_accuracy {accuracy:.4f} loss {loss:.4f} "
@@ -317,4 +403,6 @@ class SeqTrainer:
             tokens_per_sec=stats.images_per_sec,
             compile_time_s=compile_time,
             step_stats=stats,
+            resumed_from_step=start_step,
+            preempted=preempted,
         )

@@ -218,3 +218,76 @@ def test_seq_trainer_bf16_and_target_accuracy():
     assert np.isfinite(result.final_loss)
     # Early stop: hit at the FIRST eval point (batch index 1 of 4).
     assert result.history[-1][1] <= 2
+
+
+def test_seq_trainer_checkpoint_resume(tmp_path):
+    """Kill-and-resume ≡ uninterrupted: bit-for-bit when the resumed run
+    keeps the saving run's cadence (the LM step has no RNG, and identical
+    span lengths compile identical programs), and ~fp-identical across a
+    DIFFERENT eval cadence (the elastic resume_plan realignment — span
+    regrouping reassociates XLA fusion at the 1e-7 level, the same
+    envelope the CNN span-parity tests pin)."""
+    ds = synthesize_copy(
+        num_train=64, num_test=16, seq_len=T, vocab=SPEC.vocab, seed=8
+    )
+    base = dict(batch_size=16, learning_rate=1e-3, num_workers=8,
+                scheme="ring", spec=SPEC, seed=3)
+    golden = SeqTrainer(
+        SeqConfig(epochs=2, eval_every=0, **base), ds
+    ).train(log=lambda s: None)
+
+    # Stop after epoch 0 (epoch-end checkpoint), resume with the SAME
+    # cadence: bit-equal.
+    ckdir = str(tmp_path / "ck_same")
+    SeqTrainer(SeqConfig(epochs=1, eval_every=0, **base), ds).train(
+        log=lambda s: None, checkpoint_dir=ckdir
+    )
+    resumed = SeqTrainer(SeqConfig(epochs=2, eval_every=0, **base), ds).train(
+        log=lambda s: None, checkpoint_dir=ckdir, resume=True
+    )
+    assert resumed.resumed_from_step == 4  # 4 batches = epoch 0
+    for a, b in zip(jax.tree.leaves(golden.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert resumed.final_accuracy == golden.final_accuracy
+
+    # Resume under a DIFFERENT cadence (eval every batch): every batch
+    # still trains; params agree to span-reassociation tolerance.
+    ckdir = str(tmp_path / "ck_cross")
+    SeqTrainer(SeqConfig(epochs=1, eval_every=0, **base), ds).train(
+        log=lambda s: None, checkpoint_dir=ckdir
+    )
+    crossed = SeqTrainer(SeqConfig(epochs=2, eval_every=1, **base), ds).train(
+        log=lambda s: None, checkpoint_dir=ckdir, resume=True
+    )
+    assert crossed.resumed_from_step == 4
+    assert len(crossed.history) == 4  # one eval per remaining batch
+    for a, b in zip(jax.tree.leaves(golden.params),
+                    jax.tree.leaves(crossed.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        )
+
+
+def test_seq_trainer_preemption_saves_and_stops(tmp_path):
+    """should_stop flips true after the first span -> trainer saves the
+    rolling checkpoint and returns preempted=True without finishing."""
+    ds = synthesize_copy(
+        num_train=64, num_test=16, seq_len=T, vocab=SPEC.vocab, seed=9
+    )
+    ckdir = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def stop():
+        calls["n"] += 1
+        return calls["n"] > 1
+
+    result = SeqTrainer(
+        SeqConfig(epochs=4, batch_size=16, eval_every=2, num_workers=8,
+                  scheme="ring", spec=SPEC),
+        ds,
+    ).train(log=lambda s: None, checkpoint_dir=ckdir, should_stop=stop)
+    assert result.preempted
+    import os
+
+    assert os.path.exists(os.path.join(ckdir, "ckpt.npz"))
