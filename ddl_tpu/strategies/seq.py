@@ -41,13 +41,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..data.lm import LMDataset
 from ..models import transformer
 from ..models.transformer import LMSpec
 from ..ops import adam_init, adam_update
-from ..parallel import ring
+from ..parallel import multihost, ring
 from ..parallel.mesh import DP_AXIS, make_mesh
 from ..train.trainer import (
     check_preempt,
@@ -191,21 +191,25 @@ class SeqTrainer:
         self.config = config
         self.dataset = dataset
         self.mesh = make_mesh(W)
-        self.params = jax.device_put(
+        # multihost.put_tree: plain device_put single-process; in a
+        # multi-process world every controller materializes the same
+        # deterministic init and the global replicated Array is assembled
+        # from process-local data (no cross-host transfer).
+        self.params = multihost.put_tree(
+            self.mesh, P(),
             transformer.init_lm_params(
                 jax.random.PRNGKey(config.seed), config.spec
             ),
-            NamedSharding(self.mesh, P()),
         )
-        self.opt_state = jax.device_put(
-            adam_init(self.params), NamedSharding(self.mesh, P())
+        self.opt_state = multihost.put_tree(
+            self.mesh, P(), adam_init(self.params)
         )
 
     # -- compiled programs -------------------------------------------------
 
-    def _seq_sharding(self, ndim: int) -> NamedSharding:
-        spec = [None] * (ndim - 1) + [DP_AXIS]
-        return NamedSharding(self.mesh, P(*spec))
+    def _seq_spec(self, ndim: int) -> P:
+        """Sequence-sharded placement: last axis over the mesh."""
+        return P(*([None] * (ndim - 1) + [DP_AXIS]))
 
     def _span_fn(self, k: int):
         """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
@@ -250,7 +254,7 @@ class SeqTrainer:
 
     def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
         shaped = arr[: batches * bs].reshape(batches, bs, arr.shape[1])
-        return jax.device_put(shaped, self._seq_sharding(3))
+        return multihost.put(self.mesh, self._seq_spec(3), shaped)
 
     # -- training ----------------------------------------------------------
 
@@ -283,18 +287,17 @@ class SeqTrainer:
         xs = self._stage(ds.tokens, batch_num, bs)
         ys = self._stage(ds.targets, batch_num, bs)
         ws = self._stage(ds.weights, batch_num, bs)
-        xte = jax.device_put(ds.test_tokens, self._seq_sharding(2))
-        yte = jax.device_put(ds.test_targets, self._seq_sharding(2))
-        wte = jax.device_put(ds.test_weights, self._seq_sharding(2))
+        xte = multihost.put(self.mesh, self._seq_spec(2), ds.test_tokens)
+        yte = multihost.put(self.mesh, self._seq_spec(2), ds.test_targets)
+        wte = multihost.put(self.mesh, self._seq_spec(2), ds.test_weights)
         params, opt_state = self.params, self.opt_state
         ckpt = checkpoint_file(checkpoint_dir)
         tree, start_step = try_resume(
             ckpt, resume, {"params": params, "opt": opt_state}, log
         )
         if tree is not None:
-            rep = NamedSharding(self.mesh, P())
-            params = jax.device_put(tree["params"], rep)
-            opt_state = jax.device_put(tree["opt"], rep)
+            params = multihost.put_tree(self.mesh, P(), tree["params"])
+            opt_state = multihost.put_tree(self.mesh, P(), tree["opt"])
         guarded(
             lambda: force(
                 (xs, ys, ws, xte, yte, wte, params, opt_state),

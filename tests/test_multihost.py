@@ -325,3 +325,31 @@ def test_two_process_ring_attention():
     )
     for out in outs:
         assert "RING-WORLD-OK" in out
+
+
+def test_two_process_lm_world_trains_end_to_end():
+    """The lm variant across a REAL two-process world: each process owns
+    one device of the 2-way sequence-parallel mesh, so every ring-attention
+    ppermute hop in training (fwd AND the transposed grads) crosses the
+    OS-process boundary over gloo; both controllers report the identical
+    result."""
+    port = multihost.free_port()
+    common = [
+        sys.executable, "-m", "ddl_tpu", "lm", "--multihost",
+        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+        "--platform", "cpu", "--num-workers", "2", "--seq-scheme", "ring",
+        "--seq-len", "32", "--vocab", "16", "--d-model", "32", "--heads",
+        "2", "--layers", "2", "--d-ff", "64", "--train-seqs", "32",
+        "--test-seqs", "16", "--batch-size", "16", "--eval-every", "0",
+        "--json",
+    ]
+    outs = _run_world(
+        [common + ["--process-id", str(i)] for i in (0, 1)], timeout=280
+    )
+    payloads = []
+    for i, out in enumerate(outs):
+        assert f"multihost: process {i}/2, 2 global devices" in out
+        payloads.append(json.loads(out.strip().splitlines()[-1]))
+    assert payloads[0]["final_accuracy"] == payloads[1]["final_accuracy"]
+    assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
+    assert payloads[0]["config"]["scheme"] == "ring"
