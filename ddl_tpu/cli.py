@@ -164,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--target-accuracy", type=float, default=None,
                     help="stop at the first eval reaching this next-token "
                          "accuracy")
+    lm.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1 over the same mesh axis: reduce-scatter "
+                         "grads, Adam on each device's flat chunk (m/v "
+                         "owner-resident — optimizer memory /W), "
+                         "all_gather params; composes with any "
+                         "--seq-scheme")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -408,6 +414,7 @@ def _run_lm(args) -> int:
         scheme=args.seq_scheme,
         compute_dtype=_resolve_dtype(args),
         target_accuracy=args.target_accuracy,
+        zero1=args.zero1,
         spec=spec,
     )
     from .parallel.mesh import AcceleratorTimeout

@@ -21,9 +21,19 @@ Scheme selection (``SeqConfig.scheme``):
 Everything outside ``attn_fn`` is position-local, so the ONLY cross-shard
 communication per step is inside attention plus one gradient ``psum``
 (inserted automatically by ``shard_map``'s transpose for the replicated
-param cotangents) and the scalar loss normalization ``psum`` — there is
-deliberately no parameter sharding here; compose with ZeRO-1 by taking
-``strategies.sync``'s sharded update if params ever outgrow HBM.
+param cotangents) and the scalar loss normalization ``psum``.
+
+``SeqConfig.zero1`` composes the two beyond-parity stories: sequence
+parallelism × ZeRO-1. The update switches to the CNN sharded path's
+schedule (strategies/sync.py ``_sharded_step_body``) over the SAME mesh
+axis — local (unreduced) grads, one fused ``psum_scatter`` of the flat
+gradient, Adam on each device's owned chunk (m/v live ONLY on the owner:
+the 2x-optimizer-state memory saving), ``all_gather`` of the updated
+params. Collective bytes per step equal the replicated path's all-reduce
+(RS+AG is how XLA lowers a ring all-reduce anyway); what's saved is
+optimizer memory and update compute, both /W. Checkpoints store m/v in
+params-shaped form, so a run can resume across zero1 on/off AND across
+worker counts (elastic, like the CNN trainers).
 
 Same training machinery as the other strategies: device-resident
 ``eval_spans`` span programs (AOT-compiled), ``StepTimer`` percentiles,
@@ -38,6 +48,7 @@ import time
 from typing import Literal
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -47,8 +58,11 @@ from ..data.lm import LMDataset
 from ..models import transformer
 from ..models.transformer import LMSpec
 from ..ops import adam_init, adam_update
+from ..ops.optimizers import AdamState
+from ..parallel import collectives as coll
 from ..parallel import multihost, ring
-from ..parallel.mesh import DP_AXIS, make_mesh
+from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
+from .sync import ShardedAdam, _adam_flat
 from ..train.trainer import (
     check_preempt,
     checkpoint_file,
@@ -78,6 +92,9 @@ class SeqConfig:
     scheme: Scheme = "ring"
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
     target_accuracy: float | None = None
+    # ZeRO-1 over the same mesh axis: reduce-scatter grads, Adam on each
+    # device's flat chunk (m/v owner-resident), all_gather params.
+    zero1: bool = False
     spec: LMSpec = LMSpec()
 
     def dtype(self):
@@ -143,6 +160,57 @@ def _shard_sums(config: SeqConfig, fn):
     return sums
 
 
+class _FlatPlan:
+    """Static flatten/unflatten plan for the (nested) LM param tree —
+    ``jax.flatten_util.ravel_pytree`` with the unravel closure captured
+    once from a template, the nested-pytree analogue of
+    ``collectives.FlatSpec`` (which is keyed by flat variable names)."""
+
+    def __init__(self, template):
+        flat, self.unflatten = jax.flatten_util.ravel_pytree(template)
+        self.total = int(flat.size)
+
+    @staticmethod
+    def flatten(tree) -> jax.Array:
+        return jax.flatten_util.ravel_pytree(tree)[0]
+
+
+def _zero1_step_body(config: SeqConfig, plan: _FlatPlan, W: int):
+    """One ZeRO-1 train step inside ``shard_map`` (``check_vma=False``,
+    like the CNN sharded path): grads here are LOCAL — each shard
+    differentiates its own scored-token sum over the GLOBAL denominator
+    (the psum'd weight total carries no param dependence) — so the fused
+    ``psum_scatter`` performs the one and only cross-shard reduction."""
+    attn = _attn_for(config)
+    chunk = coll.chunk_size(plan.total, W)
+
+    def step(params, opt: ShardedAdam, tokens, targets, weights):
+        t_local = tokens.shape[1]
+        offset = lax.axis_index(DP_AXIS) * t_local
+
+        def local_loss(p):
+            num, den = transformer.lm_loss_sums(
+                p, tokens, targets, weights, config.spec, attn_fn=attn,
+                pos_offset=offset, compute_dtype=config.dtype(),
+            )
+            return num / lax.psum(den, DP_AXIS)
+
+        l_local, grads = jax.value_and_grad(local_loss)(params)
+        loss = lax.psum(l_local, DP_AXIS)  # global weighted mean, replicated
+        g_own = coll.reduce_scatter_flat(
+            plan.flatten(grads), W, DP_AXIS, mean=False, chunk=chunk
+        )
+        p_own = lax.dynamic_slice(
+            coll.pad_to(plan.flatten(params), chunk * W),
+            (lax.axis_index(DP_AXIS) * chunk,), (chunk,),
+        )
+        p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
+        full = lax.all_gather(p_new, DP_AXIS, tiled=True)[: plan.total]
+        return plan.unflatten(full), opt, loss
+
+    return step
+
+
 def _step_body(config: SeqConfig):
     """One train step, already inside ``shard_map``: global weighted-CE
     loss, grads for the replicated params (``shard_map`` transposes the
@@ -201,9 +269,19 @@ class SeqTrainer:
                 jax.random.PRNGKey(config.seed), config.spec
             ),
         )
-        self.opt_state = multihost.put_tree(
-            self.mesh, P(), adam_init(self.params)
-        )
+        self._plan = _FlatPlan(self.params)
+        if config.zero1:
+            chunk = coll.chunk_size(self._plan.total, W)
+            z = np.zeros(W * chunk, np.float32)
+            self.opt_state = ShardedAdam(
+                step=multihost.put(self.mesh, P(), np.zeros((), np.int32)),
+                m=multihost.put(self.mesh, P(DP_AXIS), z),
+                v=multihost.put(self.mesh, P(DP_AXIS), z.copy()),
+            )
+        else:
+            self.opt_state = multihost.put_tree(
+                self.mesh, P(), adam_init(self.params)
+            )
 
     # -- compiled programs -------------------------------------------------
 
@@ -215,14 +293,28 @@ class SeqTrainer:
         """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
         ``k`` consecutive batches as ONE device-resident program
         (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``)."""
-        step = _step_body(self.config)
-        shard_step = jax.shard_map(
-            step,
-            mesh=self.mesh,
-            in_specs=(P(), P(), P(None, DP_AXIS), P(None, DP_AXIS),
-                      P(None, DP_AXIS)),
-            out_specs=(P(), P(), P()),
-        )
+        seq = P(None, DP_AXIS)
+        if self.config.zero1:
+            opt_spec = ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS))
+            shard_step = jax.shard_map(
+                _zero1_step_body(
+                    self.config, self._plan, self.config.num_workers
+                ),
+                mesh=self.mesh,
+                in_specs=(P(), opt_spec, seq, seq, seq),
+                out_specs=(P(), opt_spec, P()),
+                # Local-grads mode (see _zero1_step_body): the rep checker
+                # would otherwise auto-psum the replicated-param cotangents
+                # and the psum_scatter would double-reduce.
+                check_vma=False,
+            )
+        else:
+            shard_step = jax.shard_map(
+                _step_body(self.config),
+                mesh=self.mesh,
+                in_specs=(P(), P(), seq, seq, seq),
+                out_specs=(P(), P(), P()),
+            )
 
         def run(params, opt_state, xs, ys, ws, first):
             def body(carry, i):
@@ -235,7 +327,11 @@ class SeqTrainer:
             )
             return params, opt_state, losses[-1]
 
-        return jax.jit(run)
+        # Donate params + optimizer state (halved peak HBM, like every
+        # other trainer's step); donation_for gates off the multi-device
+        # CPU mesh where donated replicated args deadlock the in-process
+        # AllReduce (mesh.py).
+        return jax.jit(run, donate_argnums=donation_for(self.mesh, 0, 1))
 
     def _eval_fn(self):
         sums = jax.shard_map(
@@ -255,6 +351,55 @@ class SeqTrainer:
     def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
         shaped = arr[: batches * bs].reshape(batches, bs, arr.shape[1])
         return multihost.put(self.mesh, self._seq_spec(3), shaped)
+
+    # -- checkpoint form (elastic: params-shaped m/v in BOTH modes) --------
+
+    def _opt_like(self):
+        """Host-shaped checkpoint template: Adam m/v as params-shaped
+        trees regardless of mode, so a checkpoint written by a zero1 run
+        resumes a replicated run (and vice versa) at ANY worker count —
+        the same layout-independence contract as the CNN trainers
+        (strategies/sync.py ``_opt_like``)."""
+        zeros = jax.tree.map(
+            lambda l: np.zeros(l.shape, np.float32), dict(self.params)
+        )
+        return AdamState(
+            step=np.zeros((), np.int32),
+            m=zeros,
+            v=jax.tree.map(np.copy, zeros),
+        )
+
+    def _opt_for_save(self, opt_state):
+        """Convert the live optimizer state to the checkpoint form."""
+        if not self.config.zero1:
+            return multihost.replicate_for_host(self.mesh, opt_state)
+        m, v = multihost.replicate_for_host(
+            self.mesh, (opt_state.m, opt_state.v)
+        )
+        unflat = lambda flat: jax.tree.map(
+            np.asarray, self._plan.unflatten(jnp.asarray(flat))
+        )
+        return AdamState(
+            step=np.asarray(opt_state.step), m=unflat(m), v=unflat(v)
+        )
+
+    def _place_opt(self, opt_tree):
+        """Re-place a checkpoint-form optimizer state onto this trainer's
+        mode: replicated AdamState, or flat chunks sharded over the mesh."""
+        if not self.config.zero1:
+            return multihost.put_tree(self.mesh, P(), opt_tree)
+        W = self.config.num_workers
+        chunk = coll.chunk_size(self._plan.total, W)
+        refit = lambda tree: multihost.put(
+            self.mesh, P(DP_AXIS),
+            np.pad(np.asarray(_FlatPlan.flatten(tree)),
+                   (0, W * chunk - self._plan.total)),
+        )
+        return ShardedAdam(
+            step=multihost.put(self.mesh, P(), np.asarray(opt_tree.step)),
+            m=refit(opt_tree.m),
+            v=refit(opt_tree.v),
+        )
 
     # -- training ----------------------------------------------------------
 
@@ -290,14 +435,17 @@ class SeqTrainer:
         xte = multihost.put(self.mesh, self._seq_spec(2), ds.test_tokens)
         yte = multihost.put(self.mesh, self._seq_spec(2), ds.test_targets)
         wte = multihost.put(self.mesh, self._seq_spec(2), ds.test_weights)
-        params, opt_state = self.params, self.opt_state
+        # Fresh buffers: the span programs donate params/opt (on TPU),
+        # which must never consume the trainer's own state.
+        params = jax.tree.map(jnp.copy, self.params)
+        opt_state = jax.tree.map(jnp.copy, self.opt_state)
         ckpt = checkpoint_file(checkpoint_dir)
         tree, start_step = try_resume(
-            ckpt, resume, {"params": params, "opt": opt_state}, log
+            ckpt, resume, {"params": params, "opt": self._opt_like()}, log
         )
         if tree is not None:
             params = multihost.put_tree(self.mesh, P(), tree["params"])
-            opt_state = multihost.put_tree(self.mesh, P(), tree["opt"])
+            opt_state = self._place_opt(tree["opt"])
         guarded(
             lambda: force(
                 (xs, ys, ws, xte, yte, wte, params, opt_state),
@@ -370,7 +518,10 @@ class SeqTrainer:
                         first + k == batch_num or hit or preempted,
                     ):
                         save_checkpoint(
-                            ckpt, {"params": params, "opt": opt_state},
+                            ckpt,
+                            {"params": multihost.replicate_for_host(
+                                self.mesh, params),
+                             "opt": self._opt_for_save(opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
                     if hit or preempted:
