@@ -164,12 +164,18 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--target-accuracy", type=float, default=None,
                     help="stop at the first eval reaching this next-token "
                          "accuracy")
+    lm.add_argument("--data-parallel", type=int, default=1, metavar="DP",
+                    help="2-D mesh: batch shards over DP rows while the "
+                         "sequence shards over --num-workers columns "
+                         "(total devices = DP * num-workers); --batch-size "
+                         "must divide by DP")
     lm.add_argument("--zero1", action="store_true",
-                    help="ZeRO-1 over the same mesh axis: reduce-scatter "
-                         "grads, Adam on each device's flat chunk (m/v "
-                         "owner-resident — optimizer memory /W), "
-                         "all_gather params; composes with any "
-                         "--seq-scheme")
+                    help="ZeRO-1 over the combined (dp, sp) mesh axes: "
+                         "reduce-scatter grads, Adam on each device's "
+                         "flat chunk (m/v owner-resident — optimizer "
+                         "memory /(DP*num-workers)), all_gather params; "
+                         "composes with any --seq-scheme and "
+                         "--data-parallel")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -392,13 +398,20 @@ def _run_lm(args) -> int:
     from .models.transformer import LMSpec
     from .strategies.seq import SeqConfig, SeqTrainer
 
-    num_workers = args.num_workers or _default_workers(args.variant)
-    if args.multihost:
-        _ensure_devices(num_workers, allow_fallback=False,
-                        reason="use --num-workers <= the world's global "
-                               "device count")
+    if args.data_parallel < 1:
+        raise SystemExit(f"--data-parallel must be >= 1, got {args.data_parallel}")
+    if args.num_workers:
+        num_workers = args.num_workers
     else:
-        _ensure_devices(num_workers, allow_fallback=args.platform is None,
+        # Default: all devices, split between the dp rows.
+        num_workers = max(1, _default_workers(args.variant) // args.data_parallel)
+    n_dev = num_workers * args.data_parallel
+    if args.multihost:
+        _ensure_devices(n_dev, allow_fallback=False,
+                        reason="use --num-workers * --data-parallel <= the "
+                               "world's global device count")
+    else:
+        _ensure_devices(n_dev, allow_fallback=args.platform is None,
                         reason="drop --platform to allow the "
                                "virtual-CPU-mesh fallback")
     spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
@@ -411,6 +424,7 @@ def _run_lm(args) -> int:
         eval_every=args.eval_every,
         seed=args.seed,
         num_workers=num_workers,
+        data_parallel=args.data_parallel,
         scheme=args.seq_scheme,
         compute_dtype=_resolve_dtype(args),
         target_accuracy=args.target_accuracy,

@@ -55,6 +55,45 @@ def make_mesh(
     return Mesh(np.asarray(devices), (axis,))
 
 
+# Second mesh axis for 2-D (data x sequence) parallelism: batch shards
+# over DP_AXIS rows, sequence over SP_AXIS columns (strategies/seq.py).
+SP_AXIS = "sp"
+
+
+def make_mesh_2d(
+    num_dp: int,
+    num_sp: int,
+    *,
+    axes: tuple[str, str] = (DP_AXIS, SP_AXIS),
+    devices=None,
+) -> Mesh:
+    """A ``[num_dp, num_sp]`` mesh over the first ``num_dp * num_sp``
+    devices. ``jax.devices()`` order follows the physical ICI torus, and
+    the minor (sp) axis is contiguous in it, so the sequence-parallel
+    ring's ppermute hops ride neighbouring ICI links; dp collectives
+    stride across rows (still ICI within a slice)."""
+    if num_dp < 1 or num_sp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {num_dp}x{num_sp}")
+    if devices is None:
+        devices = jax.devices()
+    n = num_dp * num_sp
+    if n > len(devices):
+        raise ValueError(
+            f"requested {num_dp}x{num_sp} devices, have {len(devices)}"
+        )
+    devices = list(devices)[:n]
+    if jax.process_count() > 1:
+        missing = set(range(jax.process_count())) - {
+            d.process_index for d in devices
+        }
+        if missing:
+            raise ValueError(
+                f"mesh over {n} devices owns no row on process(es) "
+                f"{sorted(missing)}; use a topology that spans every process"
+            )
+    return Mesh(np.asarray(devices).reshape(num_dp, num_sp), axes)
+
+
 def extend_cpu_collective_timeouts(warn_s: int = 120, kill_s: int = 900) -> None:
     """Raise XLA:CPU's in-process collective rendezvous timeouts via
     XLA_FLAGS (effective only BEFORE the CPU backend initializes).

@@ -87,25 +87,48 @@ def process_count() -> int:
 def local_worker_rows(mesh) -> np.ndarray:
     """Mesh-axis positions whose device is addressable by THIS process —
     the worker rows this process must feed (the analogue of each reference
-    worker slicing its own batches, mnist_sync/worker.py:27-30)."""
+    worker slicing its own batches, mnist_sync/worker.py:27-30). The 1-D
+    convenience form of :func:`_axis_positions`."""
+    return _axis_positions(mesh, tuple(mesh.axis_names))
+
+
+def _sharded_dims(mesh, pspec) -> list[tuple[int, tuple[str, ...], int]]:
+    """``(dim, axis_names, shard_count)`` for every ARRAY dimension the
+    spec genuinely shards — axes of mesh size 1 contribute nothing and a
+    dim whose combined shard count is 1 is replicated in all but name
+    (e.g. the batch dim of a ``[1, W]`` 2-D mesh)."""
+    import math
+
+    out = []
+    for i, entry in enumerate(tuple(pspec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        count = math.prod(mesh.shape[a] for a in names)
+        if count > 1:
+            out.append((i, tuple(names), count))
+    return out
+
+
+def _axis_positions(mesh, names: tuple[str, ...]) -> np.ndarray:
+    """Sorted unique lexicographic positions (major-to-minor in ``names``
+    order) along the combined axes that THIS process's devices occupy —
+    the n-D generalization of :func:`local_worker_rows`."""
     import jax
 
     pid = jax.process_index()
-    return np.asarray(
-        [i for i, d in enumerate(mesh.devices.flat) if d.process_index == pid],
-        dtype=np.int64,
-    )
-
-
-def sharded_dim(pspec, axis_name: str) -> int | None:
-    """The array dimension ``pspec`` shards along ``axis_name`` (None when
-    replicated). 1-D meshes: the axis appears at most once."""
-    for i, entry in enumerate(tuple(pspec)):
-        if entry == axis_name or (
-            isinstance(entry, tuple) and axis_name in entry
-        ):
-            return i
-    return None
+    axes = list(mesh.axis_names)
+    sizes = [mesh.shape[a] for a in names]
+    pos = set()
+    for idx in np.ndindex(*mesh.devices.shape):
+        if mesh.devices[idx].process_index != pid:
+            continue
+        coords = dict(zip(axes, idx))
+        lex = 0
+        for a, s in zip(names, sizes):
+            lex = lex * s + coords[a]
+        pos.add(lex)
+    return np.asarray(sorted(pos), dtype=np.int64)
 
 
 def local_slice(host_array, dim: int, num_shards: int, rows) -> np.ndarray:
@@ -135,11 +158,17 @@ def put(mesh, pspec, host_array) -> Any:
     sharding = NamedSharding(mesh, pspec)
     if jax.process_count() == 1:
         return jax.device_put(host_array, sharding)
-    dim = sharded_dim(pspec, mesh.axis_names[0])
+    dims = _sharded_dims(mesh, pspec)
     local = np.asarray(host_array)
-    if dim is not None:
-        local = local_slice(local, dim, mesh.devices.size,
-                            local_worker_rows(mesh))
+    if len(dims) > 1:
+        # Supporting >1 genuinely-sharded dim multi-process would need
+        # block (not slab) extraction; no trainer path reaches it (the
+        # 2-D lm mesh is single-controller when data_parallel > 1).
+        raise NotImplementedError(
+            f"multi-process put with {len(dims)} sharded dims ({pspec})"
+        )
+    for dim, names, count in dims:
+        local = local_slice(local, dim, count, _axis_positions(mesh, names))
     return jax.make_array_from_process_local_data(sharding, local)
 
 

@@ -74,6 +74,7 @@ def ring_attention_shard(
     q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
     axis_size: int, causal: bool = False, scale: float | None = None,
     qpos: jax.Array | None = None, kpos: jax.Array | None = None,
+    vary_axes: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis_name``; call
     INSIDE ``shard_map``. Per-shard shapes ``[B, T/P, H, D]``.
@@ -111,11 +112,16 @@ def ring_attention_shard(
         kpos = i * Tk + jnp.arange(Tk)
     qmax = qpos.max()
 
-    # pcast-to-varying: the init state must carry the mesh axis in its
+    # pcast-to-varying: the init state must carry the mesh axes in its
     # varying set, or the causal lax.cond rejects identity-vs-update
     # branches (the identity branch would return the axis-invariant init
-    # while block_update's outputs vary with this device's q/k).
-    vary = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    # while block_update's outputs vary with this device's q/k). On a
+    # multi-axis mesh where q/k/v vary over MORE than the ring axis
+    # (e.g. batch sharded over dp while the ring runs over sp), pass
+    # ``vary_axes`` with every axis the inputs vary over.
+    vary = functools.partial(
+        lax.pcast, axis_name=vary_axes or axis_name, to="varying"
+    )
     m = vary(jnp.full((B, H, Tq), _MASKED, dtype=jnp.float32))
     l = vary(jnp.zeros((B, H, Tq), dtype=jnp.float32))
     acc = vary(jnp.zeros((B, Tq, H, D), dtype=jnp.float32))

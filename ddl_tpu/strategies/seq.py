@@ -3,10 +3,11 @@
 The reference's strategy matrix stops at data parallelism and parameter
 sharding (SURVEY.md §2.3; it has no sequence axis anywhere —
 mnist_sync/model/model.py:18-19). This strategy goes beyond that matrix:
-it trains the decoder-only LM (``models.transformer``) with the SEQUENCE
-dimension sharded across the mesh, so context length scales past one
-chip's HBM — the batch stays whole on every device and each device holds
-``T / W`` positions of every sequence.
+it trains the decoder-only LM (``models.transformer``) over a 2-D
+``[data_parallel, num_workers]`` mesh — the batch shards over dp rows and
+the SEQUENCE dimension over sp columns, so context length scales past one
+chip's HBM. Each device holds ``B/dp`` sequences x ``T/sp`` positions;
+``data_parallel=1`` (the default) is pure sequence parallelism.
 
 Scheme selection (``SeqConfig.scheme``):
 
@@ -23,17 +24,19 @@ communication per step is inside attention plus one gradient ``psum``
 (inserted automatically by ``shard_map``'s transpose for the replicated
 param cotangents) and the scalar loss normalization ``psum``.
 
-``SeqConfig.zero1`` composes the two beyond-parity stories: sequence
-parallelism × ZeRO-1. The update switches to the CNN sharded path's
-schedule (strategies/sync.py ``_sharded_step_body``) over the SAME mesh
-axis — local (unreduced) grads, one fused ``psum_scatter`` of the flat
-gradient, Adam on each device's owned chunk (m/v live ONLY on the owner:
-the 2x-optimizer-state memory saving), ``all_gather`` of the updated
-params. Collective bytes per step equal the replicated path's all-reduce
-(RS+AG is how XLA lowers a ring all-reduce anyway); what's saved is
-optimizer memory and update compute, both /W. Checkpoints store m/v in
-params-shaped form, so a run can resume across zero1 on/off AND across
-worker counts (elastic, like the CNN trainers).
+``SeqConfig.zero1`` composes the beyond-parity stories: (data x
+sequence) parallelism × ZeRO-1. The update switches to the CNN sharded
+path's schedule (strategies/sync.py ``_sharded_step_body``) over the
+COMBINED mesh axes — local (unreduced) grads, one fused ``psum_scatter``
+of the flat gradient that both sums the dp/sp partial gradients and
+lands each of the dp*sp devices its owned chunk, Adam there (m/v live
+ONLY on the owner: the 2x-optimizer-state memory saving), ``all_gather``
+of the updated params. Collective bytes per step equal the replicated
+path's all-reduce (RS+AG is how XLA lowers a ring all-reduce anyway);
+what's saved is optimizer memory and update compute, both /(dp*sp).
+Checkpoints store m/v in params-shaped form, so a run can resume across
+zero1 on/off AND across any (dp, sp) topology (elastic, like the CNN
+trainers).
 
 Same training machinery as the other strategies: device-resident
 ``eval_spans`` span programs (AOT-compiled), ``StepTimer`` percentiles,
@@ -61,7 +64,7 @@ from ..ops import adam_init, adam_update
 from ..ops.optimizers import AdamState
 from ..parallel import collectives as coll
 from ..parallel import multihost, ring
-from ..parallel.mesh import DP_AXIS, donation_for, make_mesh
+from ..parallel.mesh import DP_AXIS, SP_AXIS, donation_for, make_mesh_2d
 from .sync import ShardedAdam, _adam_flat
 from ..train.trainer import (
     check_preempt,
@@ -80,15 +83,25 @@ from ..utils.metrics import StepStats, StepTimer, trace
 
 Scheme = Literal["ring", "ulysses", "full"]
 
+# The 2-D mesh: batch over rows (dp), sequence over columns (sp). A
+# data_parallel=1 config is the [1, W] degenerate case — one program
+# family covers both. Collectives that need the GLOBAL reduction (loss
+# sums, the ZeRO-1 scatter/gather) run over the combined axes, lex order
+# (dp-major) matching ``NamedSharding(P(AXES))`` chunk order.
+AXES = (DP_AXIS, SP_AXIS)
+
 
 @dataclasses.dataclass(frozen=True)
 class SeqConfig:
     epochs: int = 1
-    batch_size: int = 8  # sequences per global batch (batch is NOT sharded)
+    batch_size: int = 8  # sequences per GLOBAL batch (shards over dp rows)
     learning_rate: float = 1e-3
     eval_every: int = 10  # batches between test-set evals (0 = end only)
     seed: int = 0
-    num_workers: int = 1  # sequence-parallel degree (mesh axis size)
+    num_workers: int = 1  # sequence-parallel degree (sp mesh axis size)
+    # Data-parallel degree (dp mesh axis): the global batch shards over
+    # dp rows; total devices = data_parallel * num_workers.
+    data_parallel: int = 1
     scheme: Scheme = "ring"
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
     target_accuracy: float | None = None
@@ -128,15 +141,26 @@ def _attn_for(config: SeqConfig):
         return functools.partial(ring.full_attention, causal=True)
     if config.scheme == "ring":
         return functools.partial(
-            ring.ring_attention_shard, axis_name=DP_AXIS, axis_size=W,
-            causal=True,
+            ring.ring_attention_shard, axis_name=SP_AXIS, axis_size=W,
+            causal=True, vary_axes=AXES,
         )
     if config.scheme == "ulysses":
         return functools.partial(
-            ring.ulysses_attention_shard, axis_name=DP_AXIS, axis_size=W,
+            ring.ulysses_attention_shard, axis_name=SP_AXIS, axis_size=W,
             causal=True,
         )
     raise ValueError(f"unknown scheme {config.scheme!r}")
+
+
+def _vary_all(x):
+    """Widen ``x``'s varying set to the full 2-D mesh (no-op under
+    ``check_vma=False``, where values carry no vma type)."""
+    try:
+        vma = jax.typeof(x).vma
+    except AttributeError:
+        return x
+    missing = tuple(a for a in AXES if a not in vma)
+    return lax.pcast(x, axis_name=missing, to="varying") if missing else x
 
 
 def _shard_sums(config: SeqConfig, fn):
@@ -150,12 +174,18 @@ def _shard_sums(config: SeqConfig, fn):
 
     def sums(params, tokens, targets, weights):
         t_local = tokens.shape[1]
-        offset = lax.axis_index(DP_AXIS) * t_local
+        offset = lax.axis_index(SP_AXIS) * t_local
         num, den = fn(
             params, tokens, targets, weights, config.spec, attn_fn=attn,
             pos_offset=offset, compute_dtype=config.dtype(),
         )
-        return lax.psum(num, DP_AXIS), lax.psum(den, DP_AXIS)
+        # Global sums over BOTH axes: sp shards hold different positions,
+        # dp rows different sequences. (Eval data replicated over dp
+        # inflates num and den equally — the ratio is exact.) _vary_all
+        # widens each sum's varying set to both axes first — a partially
+        # invariant sum (eval: dp-invariant) is otherwise rejected by the
+        # combined-axes psum's vma check.
+        return lax.psum(_vary_all(num), AXES), lax.psum(_vary_all(den), AXES)
 
     return sums
 
@@ -175,37 +205,43 @@ class _FlatPlan:
         return jax.flatten_util.ravel_pytree(tree)[0]
 
 
-def _zero1_step_body(config: SeqConfig, plan: _FlatPlan, W: int):
+def _zero1_step_body(config: SeqConfig, plan: _FlatPlan):
     """One ZeRO-1 train step inside ``shard_map`` (``check_vma=False``,
     like the CNN sharded path): grads here are LOCAL — each shard
     differentiates its own scored-token sum over the GLOBAL denominator
     (the psum'd weight total carries no param dependence) — so the fused
-    ``psum_scatter`` performs the one and only cross-shard reduction."""
+    ``psum_scatter`` performs the one and only cross-shard reduction.
+    On the 2-D mesh the scatter runs over the COMBINED (dp, sp) axes:
+    one collective both sums the dp/sp partial gradients and lands each
+    of the dp*sp devices its owned chunk."""
     attn = _attn_for(config)
-    chunk = coll.chunk_size(plan.total, W)
+    n_dev = config.data_parallel * config.num_workers
+    chunk = coll.chunk_size(plan.total, n_dev)
 
     def step(params, opt: ShardedAdam, tokens, targets, weights):
         t_local = tokens.shape[1]
-        offset = lax.axis_index(DP_AXIS) * t_local
+        offset = lax.axis_index(SP_AXIS) * t_local
 
         def local_loss(p):
             num, den = transformer.lm_loss_sums(
                 p, tokens, targets, weights, config.spec, attn_fn=attn,
                 pos_offset=offset, compute_dtype=config.dtype(),
             )
-            return num / lax.psum(den, DP_AXIS)
+            return num / lax.psum(den, AXES)
 
         l_local, grads = jax.value_and_grad(local_loss)(params)
-        loss = lax.psum(l_local, DP_AXIS)  # global weighted mean, replicated
+        loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
         g_own = coll.reduce_scatter_flat(
-            plan.flatten(grads), W, DP_AXIS, mean=False, chunk=chunk
+            plan.flatten(grads), n_dev, AXES, mean=False, chunk=chunk
         )
+        my_chunk = lax.axis_index(DP_AXIS) * config.num_workers \
+            + lax.axis_index(SP_AXIS)  # lex order, = psum_scatter's split
         p_own = lax.dynamic_slice(
-            coll.pad_to(plan.flatten(params), chunk * W),
-            (lax.axis_index(DP_AXIS) * chunk,), (chunk,),
+            coll.pad_to(plan.flatten(params), chunk * n_dev),
+            (my_chunk * chunk,), (chunk,),
         )
         p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
-        full = lax.all_gather(p_new, DP_AXIS, tiled=True)[: plan.total]
+        full = lax.all_gather(p_new, AXES, tiled=True)[: plan.total]
         return plan.unflatten(full), opt, loss
 
     return step
@@ -233,14 +269,18 @@ def _step_body(config: SeqConfig):
 
 
 class SeqTrainer:
-    """Sequence-parallel LM trainer over a 1-D mesh.
+    """LM trainer over the 2-D ``[data_parallel, num_workers]`` mesh.
 
-    Data placement: token/target/weight batches ``[nb, B, T]`` sharded
-    ``P(None, None, dp)`` — every device holds all sequences but only its
-    ``T/W`` window of each; params and optimizer state replicated."""
+    Data placement: token/target/weight batches ``[nb, B, T]`` staged
+    ``P(None, dp, sp)`` — each device holds its dp row's ``B/dp``
+    sequences and its sp column's ``T/sp`` window of them; the test set
+    is ``P(None, sp)`` (dp-replicated); params and optimizer state
+    replicated (or ZeRO-1 chunks over the combined axes with
+    ``zero1=True``)."""
 
     def __init__(self, config: SeqConfig, dataset: LMDataset):
         W = config.num_workers
+        dp = config.data_parallel
         if dataset.seq_len % max(W, 1):
             raise ValueError(
                 f"seq_len {dataset.seq_len} not divisible by {W} workers"
@@ -255,10 +295,24 @@ class SeqTrainer:
                 f"dataset vocab {dataset.tokens.max() + 1} exceeds model "
                 f"vocab {config.spec.vocab}"
             )
+        if config.batch_size % max(dp, 1):
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"data_parallel {dp} (the batch shards over dp rows)"
+            )
+        if dp < 1 or W < 1:
+            raise ValueError(
+                f"data_parallel ({dp}) and num_workers ({W}) must be >= 1"
+            )
+        if dp > 1 and jax.process_count() > 1:
+            raise ValueError(
+                "data_parallel > 1 is single-controller for now "
+                "(multi-process staging slices one sharded dim)"
+            )
         _attn_for(config)  # fail fast: unknown scheme / full-with-sharding
         self.config = config
         self.dataset = dataset
-        self.mesh = make_mesh(W)
+        self.mesh = make_mesh_2d(dp, W)
         # multihost.put_tree: plain device_put single-process; in a
         # multi-process world every controller materializes the same
         # deterministic init and the global replicated Array is assembled
@@ -271,12 +325,13 @@ class SeqTrainer:
         )
         self._plan = _FlatPlan(self.params)
         if config.zero1:
-            chunk = coll.chunk_size(self._plan.total, W)
-            z = np.zeros(W * chunk, np.float32)
+            n_dev = dp * W
+            chunk = coll.chunk_size(self._plan.total, n_dev)
+            z = np.zeros(n_dev * chunk, np.float32)
             self.opt_state = ShardedAdam(
                 step=multihost.put(self.mesh, P(), np.zeros((), np.int32)),
-                m=multihost.put(self.mesh, P(DP_AXIS), z),
-                v=multihost.put(self.mesh, P(DP_AXIS), z.copy()),
+                m=multihost.put(self.mesh, P(AXES), z),
+                v=multihost.put(self.mesh, P(AXES), z.copy()),
             )
         else:
             self.opt_state = multihost.put_tree(
@@ -286,20 +341,20 @@ class SeqTrainer:
     # -- compiled programs -------------------------------------------------
 
     def _seq_spec(self, ndim: int) -> P:
-        """Sequence-sharded placement: last axis over the mesh."""
-        return P(*([None] * (ndim - 1) + [DP_AXIS]))
+        """Test-set placement: sequence over sp, batch replicated over dp
+        (test batches need not divide by dp; the psum'd num/den both
+        inflate dp-fold so accuracies stay exact)."""
+        return P(*([None] * (ndim - 1) + [SP_AXIS]))
 
     def _span_fn(self, k: int):
         """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
         ``k`` consecutive batches as ONE device-resident program
         (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``)."""
-        seq = P(None, DP_AXIS)
+        seq = P(DP_AXIS, SP_AXIS)  # train batch [B, T]: B over dp, T over sp
         if self.config.zero1:
-            opt_spec = ShardedAdam(step=P(), m=P(DP_AXIS), v=P(DP_AXIS))
+            opt_spec = ShardedAdam(step=P(), m=P(AXES), v=P(AXES))
             shard_step = jax.shard_map(
-                _zero1_step_body(
-                    self.config, self._plan, self.config.num_workers
-                ),
+                _zero1_step_body(self.config, self._plan),
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, seq, seq, seq),
                 out_specs=(P(), opt_spec, P()),
@@ -337,8 +392,8 @@ class SeqTrainer:
         sums = jax.shard_map(
             _shard_sums(self.config, transformer.lm_correct_sums),
             mesh=self.mesh,
-            in_specs=(P(), P(None, DP_AXIS), P(None, DP_AXIS),
-                      P(None, DP_AXIS)),
+            in_specs=(P(), P(None, SP_AXIS), P(None, SP_AXIS),
+                      P(None, SP_AXIS)),
             out_specs=(P(), P()),
         )
 
@@ -350,7 +405,7 @@ class SeqTrainer:
 
     def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
         shaped = arr[: batches * bs].reshape(batches, bs, arr.shape[1])
-        return multihost.put(self.mesh, self._seq_spec(3), shaped)
+        return multihost.put(self.mesh, P(None, DP_AXIS, SP_AXIS), shaped)
 
     # -- checkpoint form (elastic: params-shaped m/v in BOTH modes) --------
 
@@ -376,8 +431,11 @@ class SeqTrainer:
         m, v = multihost.replicate_for_host(
             self.mesh, (opt_state.m, opt_state.v)
         )
+        # Strip the chunk padding before unflattening — ravel_pytree's
+        # unravel consumes exactly `total` elements.
         unflat = lambda flat: jax.tree.map(
-            np.asarray, self._plan.unflatten(jnp.asarray(flat))
+            np.asarray,
+            self._plan.unflatten(jnp.asarray(flat)[: self._plan.total]),
         )
         return AdamState(
             step=np.asarray(opt_state.step), m=unflat(m), v=unflat(v)
@@ -388,12 +446,12 @@ class SeqTrainer:
         mode: replicated AdamState, or flat chunks sharded over the mesh."""
         if not self.config.zero1:
             return multihost.put_tree(self.mesh, P(), opt_tree)
-        W = self.config.num_workers
-        chunk = coll.chunk_size(self._plan.total, W)
+        n_dev = self.config.data_parallel * self.config.num_workers
+        chunk = coll.chunk_size(self._plan.total, n_dev)
         refit = lambda tree: multihost.put(
-            self.mesh, P(DP_AXIS),
+            self.mesh, P(AXES),
             np.pad(np.asarray(_FlatPlan.flatten(tree)),
-                   (0, W * chunk - self._plan.total)),
+                   (0, n_dev * chunk - self._plan.total)),
         )
         return ShardedAdam(
             step=multihost.put(self.mesh, P(), np.asarray(opt_tree.step)),

@@ -28,11 +28,29 @@ def test_local_worker_rows_single_process_owns_all():
     )
 
 
-def test_sharded_dim():
-    assert multihost.sharded_dim(P(DP_AXIS), DP_AXIS) == 0
-    assert multihost.sharded_dim(P(None, DP_AXIS), DP_AXIS) == 1
-    assert multihost.sharded_dim(P(), DP_AXIS) is None
-    assert multihost.sharded_dim(P(None, ("x", DP_AXIS)), DP_AXIS) == 1
+def test_sharded_dims_ignores_size_one_axes():
+    """_sharded_dims drives put()'s multi-process slicing: axes of mesh
+    size 1 (the dp row of a [1, W] lm mesh) must read as replicated."""
+    from ddl_tpu.parallel.mesh import make_mesh_2d
+
+    mesh = make_mesh_2d(1, 8)
+    dims = multihost._sharded_dims(mesh, P(None, DP_AXIS, "sp"))
+    assert dims == [(2, ("sp",), 8)]  # dp (size 1) contributes nothing
+    assert multihost._sharded_dims(mesh, P()) == []
+    combined = multihost._sharded_dims(mesh, P((DP_AXIS, "sp")))
+    assert combined == [(0, (DP_AXIS, "sp"), 8)]
+
+
+def test_axis_positions_single_process_owns_all():
+    from ddl_tpu.parallel.mesh import make_mesh_2d
+
+    mesh = make_mesh_2d(2, 4)
+    np.testing.assert_array_equal(
+        multihost._axis_positions(mesh, ("sp",)), np.arange(4)
+    )
+    np.testing.assert_array_equal(
+        multihost._axis_positions(mesh, (DP_AXIS, "sp")), np.arange(8)
+    )
 
 
 def test_local_slice_extracts_owner_blocks():
