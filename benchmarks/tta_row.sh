@@ -15,7 +15,7 @@ R=benchmarks/results
 mkdir -p "$R"
 # The canonical row set — `tta_row.sh --list` prints it so tpu_suite.sh
 # and tta_watch.sh iterate the SAME variants (neither hardcodes the list).
-VARIANTS="single sync async sync_sharding async_sharding"
+VARIANTS="single sync async sync_sharding async_sharding lm"
 if [ "${1:-}" = "--list" ]; then
   echo "$VARIANTS"
   exit 0

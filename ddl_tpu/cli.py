@@ -498,7 +498,11 @@ def main(argv: list[str] | None = None) -> int:
                     )
                 n_local = W // args.num_processes
             else:
-                n_local = max(args.num_workers or 8, 8)
+                # lm 2-D topologies need num_workers * data_parallel
+                # devices (data_parallel defaults to 1 elsewhere).
+                n_local = max(
+                    (args.num_workers or 8) * args.data_parallel, 8
+                )
             jax.config.update("jax_num_cpu_devices", n_local)
     if args.multihost:
         # Before any backend use: joining the world after the local backend

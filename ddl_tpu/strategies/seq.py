@@ -166,10 +166,10 @@ def _vary_all(x):
 def _shard_sums(config: SeqConfig, fn):
     """Per-shard ``(global_num, global_den)`` for an accumulator-form
     metric ``fn`` (``lm_loss_sums`` / ``lm_correct_sums``): local sums
-    over this shard's ``T/W`` positions, ``psum``med over the mesh axis.
-    Global-mean-of-sums, NOT mean-of-shard-means — the loss mask is
-    concentrated in the sequence's second half, so shards hold unequal
-    scored-token counts (data.lm module docstring)."""
+    over this shard's ``B/dp`` sequences x ``T/sp`` positions, ``psum``med
+    over BOTH mesh axes. Global-mean-of-sums, NOT mean-of-shard-means —
+    the loss mask is concentrated in the sequence's second half, so sp
+    shards hold unequal scored-token counts (data.lm module docstring)."""
     attn = _attn_for(config)
 
     def sums(params, tokens, targets, weights):
