@@ -111,6 +111,43 @@ def audit_layout(policy: str, devices: int, tiny: bool = True) -> dict:
     }
 
 
+def audit_lm(mode: str, dp: int, sp: int) -> dict:
+    """Collective schedule of the LM train step (strategies/seq.py) on a
+    ``[dp, sp]`` mesh: ``replicated`` should show the grad all-reduce
+    (plus the ring's collective-permutes); ``zero1`` should replace it
+    with reduce-scatter + all-gather of ~total/(dp*sp)-element chunks —
+    the same evidence audit_layout gives for the CNN sharded step."""
+    import jax.numpy as jnp
+
+    from ddl_tpu.data.lm import synthesize_copy
+    from ddl_tpu.models.transformer import TINY_SPEC
+    from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+
+    nseq = 2 * dp
+    ds = synthesize_copy(num_train=nseq, num_test=nseq, seq_len=8 * sp,
+                         vocab=TINY_SPEC.vocab, seed=0)
+    tr = SeqTrainer(
+        SeqConfig(num_workers=sp, data_parallel=dp, scheme="ring",
+                  zero1=(mode == "zero1"), batch_size=nseq,
+                  spec=TINY_SPEC),
+        ds,
+    )
+    xs = tr._stage(ds.tokens, 1, nseq)
+    ys = tr._stage(ds.targets, 1, nseq)
+    ws = tr._stage(ds.weights, 1, nseq)
+    txt = (tr._span_fn(1)
+           .lower(tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0))
+           .compile().as_text())
+    ops = collective_ops(txt)
+    return {
+        "mode": mode, "mesh": f"{dp}x{sp}",
+        "total_params": tr._plan.total,
+        "collectives": ops,
+        "reduce_bytes": sum(o["bytes"] for o in ops
+                            if o["op"] in ("all-reduce", "reduce-scatter")),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
@@ -133,8 +170,20 @@ def main() -> int:
         for o in r["collectives"]:
             print(f"    {o['op']:<18} {o['dtype']}{o['shape']} "
                   f"= {o['bytes']} B", file=sys.stderr)
+    half = max(2, args.devices // 2)
+    lm_rows = [
+        audit_lm("replicated", 1, args.devices),
+        audit_lm("zero1", 1, args.devices),
+        audit_lm("zero1", 2, half),
+    ]
+    for r in lm_rows:
+        print(f"[lm {r['mode']} {r['mesh']}] total={r['total_params']} "
+              f"reduce_bytes={r['reduce_bytes']}", file=sys.stderr)
+        for o in r["collectives"]:
+            print(f"    {o['op']:<18} {o['dtype']}{o['shape']} "
+                  f"= {o['bytes']} B", file=sys.stderr)
     result = {"metric": "sharded_step_collective_bytes",
-              "devices": args.devices, "layouts": rows}
+              "devices": args.devices, "layouts": rows, "lm": lm_rows}
     print(json.dumps(result))
     if args.json_path:
         with open(args.json_path, "w") as f:

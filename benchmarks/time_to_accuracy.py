@@ -29,6 +29,38 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _report(args, r, wall: float, variant: str, config: dict,
+            extra: dict | None = None) -> int:
+    """Shared report scaffolding for every TTA row (CNN and lm): crossing
+    detection from the eval history, one JSON line to stdout, optional
+    --json file. One place owns the schema so the rows can never drift."""
+    crossing = next(
+        ((e, b, a) for e, b, a in r.history if a >= args.target), None
+    )
+    result = {
+        "metric": "time_to_accuracy",
+        "variant": variant,
+        "target": args.target,
+        "reached": crossing is not None,
+        "final_accuracy": round(r.final_accuracy, 4),
+        "crossing": (
+            {"epoch": crossing[0], "batch": crossing[1],
+             "accuracy": round(crossing[2], 4)} if crossing else None
+        ),
+        "train_time_s": round(r.train_time_s, 2),
+        "wall_time_s": round(wall, 2),
+        "compile_time_s": round(r.compile_time_s, 2),
+        **(extra or {}),
+        "evals": [(e, b, round(a, 4)) for e, b, a in r.history],
+        "config": config,
+    }
+    print(json.dumps(result))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(result, f, indent=2)
+    return 0
+
+
 def run_lm(args) -> int:
     """The long-context family's accuracy-as-oracle row: the decoder LM
     trains on the procedural copy task (data/lm.py — solvable only via
@@ -57,36 +89,16 @@ def run_lm(args) -> int:
     r = trainer.train(log=lambda s: print(f"[tta] {s}", file=sys.stderr),
                       dispatch_timeout=args.dispatch_timeout)
     wall = time.perf_counter() - t0
-    crossing = next(
-        ((e, b, a) for e, b, a in r.history if a >= args.target), None
-    )
-    result = {
-        "metric": "time_to_accuracy",
-        "variant": "lm",
-        "target": args.target,
-        "reached": crossing is not None,
-        "final_accuracy": round(r.final_accuracy, 4),
-        "crossing": (
-            {"epoch": crossing[0], "batch": crossing[1],
-             "accuracy": round(crossing[2], 4)} if crossing else None
-        ),
-        "train_time_s": round(r.train_time_s, 2),
-        "wall_time_s": round(wall, 2),
-        "compile_time_s": round(r.compile_time_s, 2),
-        "tokens_per_sec": round(r.tokens_per_sec, 1),
-        "evals": [(e, b, round(a, 4)) for e, b, a in r.history],
-        "config": {
+    return _report(
+        args, r, wall, "lm",
+        config={
             "workers": args.workers, "batch": args.batch, "lr": args.lr,
             "bf16": args.bf16, "train_seqs": args.train,
             "seq_len": args.seq_len, "max_epochs": args.max_epochs,
             "eval_every": args.eval_every, "scheme": cfg.scheme,
         },
-    }
-    print(json.dumps(result))
-    if args.json_path:
-        with open(args.json_path, "w") as f:
-            json.dump(result, f, indent=2)
-    return 0
+        extra={"tokens_per_sec": round(r.tokens_per_sec, 1)},
+    )
 
 
 def main() -> int:
@@ -176,36 +188,15 @@ def main() -> int:
     r = trainer.train(log=lambda s: print(f"[tta] {s}", file=sys.stderr),
                       dispatch_timeout=args.dispatch_timeout)
     wall = time.perf_counter() - t0
-
-    crossing = next(
-        ((e, b, a) for e, b, a in r.history if a >= args.target), None
-    )
-    result = {
-        "metric": "time_to_accuracy",
-        "variant": args.variant,
-        "target": args.target,
-        "reached": crossing is not None,
-        "final_accuracy": round(r.final_accuracy, 4),
-        "crossing": (
-            {"epoch": crossing[0], "batch": crossing[1],
-             "accuracy": round(crossing[2], 4)} if crossing else None
-        ),
-        "train_time_s": round(r.train_time_s, 2),
-        "wall_time_s": round(wall, 2),
-        "compile_time_s": round(r.compile_time_s, 2),
-        "evals": [(e, b, round(a, 4)) for e, b, a in r.history],
-        "config": {
+    return _report(
+        args, r, wall, args.variant,
+        config={
             "workers": args.workers, "batch": args.batch, "lr": args.lr,
             "bf16": args.bf16, "train_images": args.train,
             "max_epochs": args.max_epochs, "eval_every": args.eval_every,
             "num_ps": cfg.num_ps, "layout": cfg.layout,
         },
-    }
-    print(json.dumps(result))
-    if args.json_path:
-        with open(args.json_path, "w") as f:
-            json.dump(result, f, indent=2)
-    return 0
+    )
 
 
 if __name__ == "__main__":
