@@ -55,6 +55,9 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--d-ff", type=int, default=2048)
     ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--attn-impls", nargs="+", default=["xla", "flash"],
+                    help="local attention kernels to sweep (scheme=full): "
+                         "xla einsum softmax vs the Pallas flash kernel")
     ap.add_argument("--json", type=str, default=None)
     args = ap.parse_args()
 
@@ -90,36 +93,39 @@ def main() -> None:
         k = args.span
         ds = synthesize_copy(num_train=B * k, num_test=B, seq_len=T,
                              vocab=args.vocab, seed=0)
-        cfg = SeqConfig(num_workers=1, scheme="full",
-                        compute_dtype="bfloat16", batch_size=B, spec=spec)
-        tr = SeqTrainer(cfg, ds)
-        xs = tr._stage(ds.tokens, k, B)
-        ys = tr._stage(ds.targets, k, B)
-        ws = tr._stage(ds.weights, k, B)
-        params, opt = tr.params, tr.opt_state
-        force((xs, ys, ws, params, opt), all_leaves=True)
-        t0 = time.perf_counter()
-        fn = (tr._span_fn(k)
-              .lower(params, opt, xs, ys, ws, jnp.int32(0)).compile())
-        compile_s = time.perf_counter() - t0
-        params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
-        force((params, opt, loss))  # warmup barrier
-        tps = []
-        for _ in range(args.repeats):
+        rows[T] = {"seqs_per_batch": B}
+        for impl in args.attn_impls:
+            cfg = SeqConfig(num_workers=1, scheme="full",
+                            compute_dtype="bfloat16", batch_size=B,
+                            attn_impl=impl, spec=spec)
+            tr = SeqTrainer(cfg, ds)
+            xs = tr._stage(ds.tokens, k, B)
+            ys = tr._stage(ds.targets, k, B)
+            ws = tr._stage(ds.weights, k, B)
+            params, opt = tr.params, tr.opt_state
+            force((xs, ys, ws, params, opt), all_leaves=True)
             t0 = time.perf_counter()
+            fn = (tr._span_fn(k)
+                  .lower(params, opt, xs, ys, ws, jnp.int32(0)).compile())
+            compile_s = time.perf_counter() - t0
             params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
-            force((params, opt, loss))  # true barrier: host fetch
-            tps.append(k * B * T / (time.perf_counter() - t0))
-        best, med = float(max(tps)), float(np.median(tps))
-        mfu = (round(100.0 * best * flops_per_token(spec, T) / peak, 2)
-               if peak else None)
-        rows[T] = {
-            "seqs_per_batch": B, "best_tokens_per_s": round(best, 1),
-            "median_tokens_per_s": round(med, 1), "mfu_pct": mfu,
-            "compile_s": round(compile_s, 1),
-        }
-        print(f"[lm_bench] T={T} B={B}: best {best:,.0f} tok/s "
-              f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
+            force((params, opt, loss))  # warmup barrier
+            tps = []
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
+                force((params, opt, loss))  # true barrier: host fetch
+                tps.append(k * B * T / (time.perf_counter() - t0))
+            best, med = float(max(tps)), float(np.median(tps))
+            mfu = (round(100.0 * best * flops_per_token(spec, T) / peak, 2)
+                   if peak else None)
+            rows[T][impl] = {
+                "best_tokens_per_s": round(best, 1),
+                "median_tokens_per_s": round(med, 1), "mfu_pct": mfu,
+                "compile_s": round(compile_s, 1),
+            }
+            print(f"[lm_bench] T={T} B={B} {impl}: best {best:,.0f} tok/s "
+                  f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
 
     out = {
         "metric": "lm_train_tokens_per_sec",

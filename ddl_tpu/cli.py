@@ -164,6 +164,11 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--target-accuracy", type=float, default=None,
                     help="stop at the first eval reaching this next-token "
                          "accuracy")
+    lm.add_argument("--attn-impl", default="xla", choices=["xla", "flash"],
+                    help="local attention kernel: xla (einsum softmax) or "
+                         "flash (Pallas flash-attention kernel on TPU — "
+                         "O(T*block) score memory; pure-JAX reference "
+                         "off-TPU); schemes full/ulysses only")
     lm.add_argument("--data-parallel", type=int, default=1, metavar="DP",
                     help="2-D mesh: batch shards over DP rows while the "
                          "sequence shards over --num-workers columns "
@@ -429,6 +434,7 @@ def _run_lm(args) -> int:
         compute_dtype=_resolve_dtype(args),
         target_accuracy=args.target_accuracy,
         zero1=args.zero1,
+        attn_impl=args.attn_impl,
         spec=spec,
     )
     from .parallel.mesh import AcceleratorTimeout

@@ -1,0 +1,63 @@
+"""Fused flash attention — the Pallas path for the LM's hot op.
+
+``models.transformer.full_attention`` (via ``parallel.ring``) materializes
+the whole ``[B, H, T, T]`` fp32 score matrix per layer; at long context
+that is the dominant HBM cost (T=4096, H=8, B=2 ⇒ ~1 GB per layer just
+for scores). This module routes the local attention computation through
+the TPU flash-attention Pallas kernel bundled with JAX
+(``jax.experimental.pallas.ops.tpu.flash_attention`` — tiled online
+softmax, O(T * block) score memory, custom_vjp so training works), the
+same selected-on-TPU pattern as the fused Adam kernel
+(``ops/pallas_adam.py``).
+
+Off-TPU the kernel cannot lower (Mosaic is TPU-only), so the wrapper
+falls back to the kernel's own pure-JAX reference twin
+(``mha_reference_no_custom_vjp`` — same math, autodiff gradients): the
+CPU test mesh exercises every caller's plumbing, and tests pin the
+fallback against the repo oracle (``ring.full_attention``) fwd+grad.
+
+Where it plugs in (``strategies.seq.SeqConfig.attn_impl = "flash"``):
+- scheme ``full``: directly — the whole-sequence kernel.
+- scheme ``ulysses``: as the local kernel after the all_to_all head
+  re-partition (each device computes full-sequence attention over its
+  head subset — exactly the kernel's shape).
+- scheme ``ring``: NOT available — the ring's streaming-softmax state
+  (m, l, acc) must cross ``ppermute`` steps, which the bundled kernel
+  does not expose; the ring keeps its hand-rolled blockwise update.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+
+def flash_attention_bthd(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Flash attention over ``[B, T, H, D]`` (the model's layout; the
+    kernel wants ``[B, H, T, D]`` — transposed in and out). Causality is
+    from position 0 (aligned q/k — the full/ulysses cases); there is no
+    offset support, so this cannot serve as the ring's travelling-block
+    kernel. On TPU, T should be a multiple of the kernel's 128-lane
+    block for best tiling (the kernel validates its own constraints)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    if jax.default_backend() == "tpu":
+        out = _fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale)
+    else:
+        # fp32 score accumulation like both the TPU kernel and the repo's
+        # einsum path (ring.full_attention upcasts scores) — the bf16
+        # reference would otherwise accumulate the softmax in ~3
+        # significant digits and drift from the TPU run at long T.
+        out = _fa.mha_reference_no_custom_vjp(
+            qt.astype(jax.numpy.float32), kt.astype(jax.numpy.float32),
+            vt.astype(jax.numpy.float32), None, causal=causal,
+            sm_scale=scale,
+        ).astype(q.dtype)
+    return out.transpose(0, 2, 1, 3)

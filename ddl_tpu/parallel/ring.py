@@ -171,16 +171,25 @@ def ring_attention_shard(
 def ulysses_attention_shard(
     q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
     axis_size: int, causal: bool = False, scale: float | None = None,
+    local_attn=None,
 ) -> jax.Array:
     """Ulysses sequence parallelism; call INSIDE ``shard_map``. Per-shard
     ``[B, T/P, H, D]`` with ``H % P == 0``: one ``all_to_all`` turns the
     sequence sharding into a head sharding ``[B, T, H/P, D]``, a plain
-    full-sequence :func:`full_attention` runs on the local head subset,
-    and a second ``all_to_all`` restores sequence sharding."""
+    full-sequence local kernel runs on the head subset, and a second
+    ``all_to_all`` restores sequence sharding. ``local_attn`` overrides
+    the kernel — a ``(q, k, v) -> out`` closure over full-sequence
+    ``[B, T, H/P, D]`` with causality/scale already bound (e.g. the
+    Pallas flash kernel, ops/attention.py); default
+    :func:`full_attention`."""
     H = q.shape[2]
     if H % axis_size:
         raise ValueError(
             f"ulysses needs num_heads % axis_size == 0, got {H} % {axis_size}"
+        )
+    if local_attn is None:
+        local_attn = functools.partial(
+            full_attention, causal=causal, scale=scale
         )
     a2a = functools.partial(
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
@@ -190,9 +199,7 @@ def ulysses_attention_shard(
         lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
         tiled=True,
     )
-    out = full_attention(
-        a2a(q), a2a(k), a2a(v), causal=causal, scale=scale
-    )
+    out = local_attn(a2a(q), a2a(k), a2a(v))
     return back(out)
 
 

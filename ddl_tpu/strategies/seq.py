@@ -108,6 +108,12 @@ class SeqConfig:
     # ZeRO-1 over the same mesh axis: reduce-scatter grads, Adam on each
     # device's flat chunk (m/v owner-resident), all_gather params.
     zero1: bool = False
+    # Local attention kernel: "xla" = the plain einsum softmax
+    # (materializes [B, H, T, T] scores); "flash" = the Pallas flash
+    # kernel on TPU / its pure-JAX reference off-TPU (ops/attention.py).
+    # Available for schemes full and ulysses; the ring keeps its own
+    # blockwise streaming softmax.
+    attn_impl: Literal["xla", "flash"] = "xla"
     spec: LMSpec = LMSpec()
 
     def dtype(self):
@@ -132,12 +138,25 @@ class LMResult:
 def _attn_for(config: SeqConfig):
     """The per-shard attention closure for this config — always causal
     (decoder LM). ``full`` is the W=1 oracle; ring/ulysses derive their
-    absolute positions from ``lax.axis_index`` inside the shard."""
+    absolute positions from ``lax.axis_index`` inside the shard.
+    ``attn_impl="flash"`` swaps the full-sequence kernel for the Pallas
+    flash kernel (ops/attention.py) where the shapes allow it."""
     W = config.num_workers
+    flash = config.attn_impl == "flash"
+    if flash and config.scheme == "ring":
+        raise ValueError(
+            "attn_impl='flash' supports schemes full and ulysses; the "
+            "ring's travelling-block softmax state cannot route through "
+            "the bundled kernel (ops/attention.py module docstring)"
+        )
     if config.scheme == "full":
         if W != 1:
             raise ValueError("scheme='full' cannot shard the sequence; "
                              "use ring or ulysses for num_workers > 1")
+        if flash:
+            from ..ops.attention import flash_attention_bthd
+
+            return functools.partial(flash_attention_bthd, causal=True)
         return functools.partial(ring.full_attention, causal=True)
     if config.scheme == "ring":
         return functools.partial(
@@ -145,9 +164,14 @@ def _attn_for(config: SeqConfig):
             causal=True, vary_axes=AXES,
         )
     if config.scheme == "ulysses":
+        local = None
+        if flash:
+            from ..ops.attention import flash_attention_bthd
+
+            local = functools.partial(flash_attention_bthd, causal=True)
         return functools.partial(
             ring.ulysses_attention_shard, axis_name=SP_AXIS, axis_size=W,
-            causal=True,
+            causal=True, local_attn=local,
         )
     raise ValueError(f"unknown scheme {config.scheme!r}")
 

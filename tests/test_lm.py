@@ -406,3 +406,66 @@ def test_seq_trainer_2d_rejects_indivisible_batch():
             SeqConfig(batch_size=5, num_workers=4, data_parallel=2,
                       spec=SPEC), ds
         )
+
+
+def test_flash_attention_matches_oracle():
+    """ops/attention.py off-TPU routes the kernel's pure-JAX reference —
+    fwd and grads must match the repo oracle (the TPU Pallas kernel is
+    the same math; lm_bench measures it on hardware)."""
+    from ddl_tpu.ops.attention import flash_attention_bthd
+
+    key = jax.random.PRNGKey(14)
+    q, k, v = (jax.random.normal(s, (2, 64, 4, 16))
+               for s in jax.random.split(key, 3))
+    oracle = ring.full_attention(q, k, v, causal=True)
+    got = flash_attention_bthd(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                               atol=2e-6, rtol=1e-5)
+    g1 = jax.grad(lambda q: (ring.full_attention(q, k, v, causal=True) ** 2)
+                  .sum())(q)
+    g2 = jax.grad(lambda q: (flash_attention_bthd(q, k, v, causal=True) ** 2)
+                  .sum())(q)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
+                               atol=1e-5, rtol=1e-4)
+    # bf16 inputs: output dtype follows q, accumulation stays fp32 (the
+    # fallback upcasts like the TPU kernel), so the bf16 result rounds
+    # the fp32 oracle rather than drifting.
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+    got16 = flash_attention_bthd(qb, kb, vb, causal=True)
+    assert got16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got16, dtype=np.float32), np.asarray(oracle),
+        atol=5e-2, rtol=5e-2,
+    )
+
+
+def test_seq_trainer_flash_matches_xla():
+    """attn_impl='flash' (reference path on the CPU mesh) trains to the
+    same result as the einsum kernel, for both schemes that support it;
+    ring + flash is rejected."""
+    ds = synthesize_copy(
+        num_train=64, num_test=32, seq_len=T, vocab=SPEC.vocab, seed=15
+    )
+    base = dict(epochs=1, batch_size=16, learning_rate=1e-3, eval_every=0,
+                spec=SPEC, seed=8)
+    for scheme, w in (("full", 1), ("ulysses", 2)):
+        xla = SeqTrainer(
+            SeqConfig(num_workers=w, scheme=scheme, **base), ds
+        ).train(log=lambda s: None)
+        fl = SeqTrainer(
+            SeqConfig(num_workers=w, scheme=scheme, attn_impl="flash",
+                      **base), ds
+        ).train(log=lambda s: None)
+        assert np.isclose(fl.final_loss, xla.final_loss, rtol=1e-4), (
+            scheme, fl.final_loss, xla.final_loss
+        )
+        for a, b in zip(jax.tree.leaves(xla.params),
+                        jax.tree.leaves(fl.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-3
+            )
+    with pytest.raises(ValueError, match="flash"):
+        SeqTrainer(
+            SeqConfig(num_workers=8, scheme="ring", attn_impl="flash",
+                      **base), ds
+        )
