@@ -1,3 +1,4 @@
+from .attention import flash_attention_bthd  # noqa: F401
 from .optimizers import AdamState, adam_init, adam_update
 
-__all__ = ["AdamState", "adam_init", "adam_update"]
+__all__ = ["AdamState", "adam_init", "adam_update", "flash_attention_bthd"]
