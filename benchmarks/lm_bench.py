@@ -10,10 +10,12 @@ defers execution until a fetch — BASELINE.md "measurement integrity").
 Reports tokens/s and an analytic MFU: train FLOPs/token =
 ``6*P_mat + 6*L*T_eff*d`` with ``T_eff = T/2`` (causal), where ``P_mat``
 counts matmul parameters (blocks + output head; the embedding gather is
-not a matmul). One chip has no sequence to shard (scheme=full — the
-oracle kernel); the cross-chip schemes' *program structure* is covered
-by the virtual-mesh scaling proxy and tests/test_ring.py, and their
-memory law (O(T/P * T/P) scores/device) by
+not a matmul). One chip has no sequence to shard (scheme=full), so the
+sweep compares the LOCAL kernels head-to-head per sequence length:
+the xla einsum softmax vs the Pallas flash-attention kernel
+(``--attn-impls``). The cross-chip schemes' *program structure* is
+covered by the virtual-mesh scaling proxy and tests/test_ring.py, and
+their memory law (O(T/P * T/P) scores/device) by
 test_ring_attention_memory_is_blockwise.
 
     python benchmarks/lm_bench.py --json benchmarks/results/lm_tpu.json
