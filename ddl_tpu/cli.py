@@ -169,6 +169,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "flash (Pallas flash-attention kernel on TPU — "
                          "O(T*block) score memory; pure-JAX reference "
                          "off-TPU); schemes full/ulysses only")
+    lm.add_argument("--seq-layout", default="contiguous",
+                    choices=["contiguous", "zigzag"],
+                    help="ring position layout: contiguous (block i on "
+                         "device i — device P-1 computes every causal ring "
+                         "step) or zigzag (two-ended chunk pairs — halves "
+                         "the causal critical path; scheme=ring, seq-len "
+                         "divisible by 2*num-workers)")
     lm.add_argument("--data-parallel", type=int, default=1, metavar="DP",
                     help="2-D mesh: batch shards over DP rows while the "
                          "sequence shards over --num-workers columns "
@@ -435,6 +442,7 @@ def _run_lm(args) -> int:
         target_accuracy=args.target_accuracy,
         zero1=args.zero1,
         attn_impl=args.attn_impl,
+        seq_layout=args.seq_layout,
         spec=spec,
     )
     from .parallel.mesh import AcceleratorTimeout
@@ -446,6 +454,15 @@ def _run_lm(args) -> int:
             seq_len=args.seq_len, vocab=args.vocab, seed=args.seed,
         )
         trainer = SeqTrainer(cfg, dataset)
+    except ValueError as e:
+        # Config-shaped errors (odd seq_len, tiny vocab, indivisible
+        # shards, batch > dataset) become clean CLI failures. ONLY
+        # construction is guarded: every config pre-flight lives in
+        # SeqTrainer.__init__, so a ValueError escaping train() below is
+        # a real runtime bug (corrupt checkpoint, JAX shape error) and
+        # keeps its traceback (round-4 advisor).
+        raise SystemExit(f"lm config error: {e}")
+    try:
         result = trainer.train(
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
@@ -454,11 +471,6 @@ def _run_lm(args) -> int:
             should_stop=lambda: term["flag"],
             dispatch_timeout=args.dispatch_timeout,
         )
-    except ValueError as e:
-        # Config-shaped errors (odd seq_len, tiny vocab, indivisible
-        # shards, batch > dataset) become clean CLI failures; train()
-        # raises ValueError only from its pre-flight batch check.
-        raise SystemExit(f"lm config error: {e}")
     except AcceleratorTimeout as e:
         return _fatal_timeout(e)
     print(f"training time: {result.train_time_s:.2f}s "

@@ -139,21 +139,27 @@ def apply_lm(
     *,
     attn_fn: AttnFn,
     pos_offset: int | jax.Array = 0,
+    positions: jax.Array | None = None,
     compute_dtype=None,
 ) -> jax.Array:
     """Forward pass: int tokens ``[B, T]`` -> fp32 logits ``[B, T, vocab]``.
 
     ``T`` may be the full sequence or a shard of it; ``pos_offset`` is the
     absolute position of element 0 (a traced ``lax.axis_index`` expression
-    under ``shard_map``). ``attn_fn`` performs (possibly cross-shard)
-    attention on post-RoPE ``[B, T, H, D]`` q/k/v and owns causal masking —
-    the model applies no mask itself.
+    under ``shard_map``). A shard holding NON-contiguous positions (the
+    ring's balanced zigzag layout, parallel/ring.zigzag_positions) passes
+    the full per-token ``positions [T]`` instead, which overrides
+    ``pos_offset`` — RoPE needs only absolute positions, never adjacency.
+    ``attn_fn`` performs (possibly cross-shard) attention on post-RoPE
+    ``[B, T, H, D]`` q/k/v and owns causal masking — the model applies no
+    mask itself.
     """
     if compute_dtype is not None:
         params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
     h = params["embed"][tokens]  # [B, T, E]
     b, t, e = h.shape
-    positions = pos_offset + jnp.arange(t)
+    if positions is None:
+        positions = pos_offset + jnp.arange(t)
     heads = lambda a: a.reshape(b, t, spec.num_heads, spec.head_dim)
     for blk in params["blocks"]:
         x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
@@ -176,6 +182,7 @@ def lm_loss_sums(
     *,
     attn_fn: AttnFn,
     pos_offset: int | jax.Array = 0,
+    positions: jax.Array | None = None,
     compute_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted next-token cross-entropy as ``(sum_ce, sum_weights)`` —
@@ -186,7 +193,7 @@ def lm_loss_sums(
     copy task where only second-half positions are scored)."""
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
-        compute_dtype=compute_dtype,
+        positions=positions, compute_dtype=compute_dtype,
     )
     logprobs = jax.nn.log_softmax(logits)
     ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -203,6 +210,7 @@ def lm_correct_sums(
     *,
     attn_fn: AttnFn,
     pos_offset: int | jax.Array = 0,
+    positions: jax.Array | None = None,
     compute_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted top-1 next-token hits as ``(sum_correct, sum_weights)``
@@ -210,7 +218,7 @@ def lm_correct_sums(
     analogue of ``cnn.correct_count``)."""
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
-        compute_dtype=compute_dtype,
+        positions=positions, compute_dtype=compute_dtype,
     )
     hits = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
     w = weights.astype(jnp.float32)

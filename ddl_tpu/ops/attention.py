@@ -37,18 +37,27 @@ from jax.experimental.pallas.ops.tpu import flash_attention as _fa
 
 def flash_attention_bthd(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
-    scale: float | None = None,
+    scale: float | None = None, platform: str | None = None,
 ) -> jax.Array:
     """Flash attention over ``[B, T, H, D]`` (the model's layout; the
     kernel wants ``[B, H, T, D]`` — transposed in and out). Causality is
     from position 0 (aligned q/k — the full/ulysses cases); there is no
     offset support, so this cannot serve as the ring's travelling-block
     kernel. On TPU, T should be a multiple of the kernel's 128-lane
-    block for best tiling (the kernel validates its own constraints)."""
+    block for best tiling (the kernel validates its own constraints).
+
+    ``platform`` is the platform of the devices the computation will run
+    on (``mesh.devices.flat[0].platform`` for a mesh program — what
+    ``strategies.seq`` passes); kernel selection happens at trace time,
+    when placement is not introspectable, so callers placing the program
+    on a non-default backend must say so. ``None`` falls back to
+    ``jax.default_backend()`` (round-4 advisor)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if platform is None:
+        platform = jax.default_backend()
     qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
-    if jax.default_backend() == "tpu":
+    if platform == "tpu":
         out = _fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale)
     else:
         # fp32 score accumulation like both the TPU kernel and the repo's

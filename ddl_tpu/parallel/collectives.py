@@ -94,13 +94,17 @@ def pad_to(flat: jax.Array, padded_total: int) -> jax.Array:
 
 
 def reduce_scatter_flat(
-    flat: jax.Array, num_shards: int, axis: str, *, mean: bool,
-    chunk: int | None = None
+    flat: jax.Array, num_shards: int, axis: str | tuple[str, ...], *,
+    mean: bool, chunk: int | None = None
 ) -> jax.Array:
     """Inside shard_map: fused reduce-scatter of a (padded) flat vector.
     Returns this device's reduced chunk ``[chunk]``. Pass the layout's
     ``max_shard`` as ``chunk`` so the row split matches the flat layout's
-    lane-aligned shard boundaries."""
+    lane-aligned shard boundaries. ``axis`` may be a TUPLE of mesh axes
+    (the 2-D ZeRO-1 path, strategies/seq.py): ``psum_scatter`` then both
+    sums over all of them and splits the rows over the combined axes in
+    lex order — first axis major, matching ``NamedSharding(P(axes))``
+    chunk order and the caller's ``axis_index``-based owner arithmetic."""
     if chunk is None:
         chunk = chunk_size(flat.shape[0], num_shards)
     padded = pad_to(flat, chunk * num_shards)

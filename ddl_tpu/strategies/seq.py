@@ -114,6 +114,14 @@ class SeqConfig:
     # Available for schemes full and ulysses; the ring keeps its own
     # blockwise streaming softmax.
     attn_impl: Literal["xla", "flash"] = "xla"
+    # Position-to-device layout for scheme="ring": "contiguous" = block i
+    # on device i (device P-1 then computes on EVERY causal ring step —
+    # the last-device hot spot); "zigzag" = the two-ended layout (device i
+    # holds chunks i and 2P-1-i of 2P), which halves the causal critical
+    # path (ring.causal_work_profile). Data movement is a staging-time
+    # gather (ring.zigzag_permutation); RoPE gets the matching absolute
+    # positions, so training is numerically the same computation.
+    seq_layout: Literal["contiguous", "zigzag"] = "contiguous"
     spec: LMSpec = LMSpec()
 
     def dtype(self):
@@ -135,12 +143,16 @@ class LMResult:
     preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
 
 
-def _attn_for(config: SeqConfig):
+def _attn_for(config: SeqConfig, platform: str | None = None):
     """The per-shard attention closure for this config — always causal
     (decoder LM). ``full`` is the W=1 oracle; ring/ulysses derive their
     absolute positions from ``lax.axis_index`` inside the shard.
     ``attn_impl="flash"`` swaps the full-sequence kernel for the Pallas
-    flash kernel (ops/attention.py) where the shapes allow it."""
+    flash kernel (ops/attention.py) where the shapes allow it;
+    ``platform`` is the mesh's device platform, forwarded so kernel
+    selection follows where the program actually runs, not the default
+    backend (round-4 advisor — a trainer jitting onto a non-default
+    backend would otherwise pick the wrong kernel)."""
     W = config.num_workers
     flash = config.attn_impl == "flash"
     if flash and config.scheme == "ring":
@@ -156,24 +168,39 @@ def _attn_for(config: SeqConfig):
         if flash:
             from ..ops.attention import flash_attention_bthd
 
-            return functools.partial(flash_attention_bthd, causal=True)
+            return functools.partial(
+                flash_attention_bthd, causal=True, platform=platform
+            )
         return functools.partial(ring.full_attention, causal=True)
     if config.scheme == "ring":
         return functools.partial(
             ring.ring_attention_shard, axis_name=SP_AXIS, axis_size=W,
-            causal=True, vary_axes=AXES,
+            causal=True, vary_axes=AXES, layout=config.seq_layout,
         )
     if config.scheme == "ulysses":
         local = None
         if flash:
             from ..ops.attention import flash_attention_bthd
 
-            local = functools.partial(flash_attention_bthd, causal=True)
+            local = functools.partial(
+                flash_attention_bthd, causal=True, platform=platform
+            )
         return functools.partial(
             ring.ulysses_attention_shard, axis_name=SP_AXIS, axis_size=W,
             causal=True, local_attn=local,
         )
     raise ValueError(f"unknown scheme {config.scheme!r}")
+
+
+def _shard_positions(config: SeqConfig, t_local: int) -> jax.Array:
+    """This sp shard's absolute token positions ``[t_local]`` (traced —
+    ``lax.axis_index`` based), per the config's layout. Feeds BOTH RoPE
+    (transformer ``positions=``) and the ring's causal masking, so the
+    two can never disagree about where a shard's tokens live."""
+    i = lax.axis_index(SP_AXIS)
+    if config.seq_layout == "zigzag":
+        return ring.zigzag_positions(i, config.num_workers, t_local)
+    return i * t_local + jnp.arange(t_local)
 
 
 def _vary_all(x):
@@ -187,21 +214,21 @@ def _vary_all(x):
     return lax.pcast(x, axis_name=missing, to="varying") if missing else x
 
 
-def _shard_sums(config: SeqConfig, fn):
+def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
     """Per-shard ``(global_num, global_den)`` for an accumulator-form
     metric ``fn`` (``lm_loss_sums`` / ``lm_correct_sums``): local sums
     over this shard's ``B/dp`` sequences x ``T/sp`` positions, ``psum``med
     over BOTH mesh axes. Global-mean-of-sums, NOT mean-of-shard-means —
     the loss mask is concentrated in the sequence's second half, so sp
     shards hold unequal scored-token counts (data.lm module docstring)."""
-    attn = _attn_for(config)
+    attn = _attn_for(config, platform)
 
     def sums(params, tokens, targets, weights):
         t_local = tokens.shape[1]
-        offset = lax.axis_index(SP_AXIS) * t_local
         num, den = fn(
             params, tokens, targets, weights, config.spec, attn_fn=attn,
-            pos_offset=offset, compute_dtype=config.dtype(),
+            positions=_shard_positions(config, t_local),
+            compute_dtype=config.dtype(),
         )
         # Global sums over BOTH axes: sp shards hold different positions,
         # dp rows different sequences. (Eval data replicated over dp
@@ -229,7 +256,8 @@ class _FlatPlan:
         return jax.flatten_util.ravel_pytree(tree)[0]
 
 
-def _zero1_step_body(config: SeqConfig, plan: _FlatPlan):
+def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
+                     platform: str | None = None):
     """One ZeRO-1 train step inside ``shard_map`` (``check_vma=False``,
     like the CNN sharded path): grads here are LOCAL — each shard
     differentiates its own scored-token sum over the GLOBAL denominator
@@ -238,18 +266,18 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan):
     On the 2-D mesh the scatter runs over the COMBINED (dp, sp) axes:
     one collective both sums the dp/sp partial gradients and lands each
     of the dp*sp devices its owned chunk."""
-    attn = _attn_for(config)
+    attn = _attn_for(config, platform)
     n_dev = config.data_parallel * config.num_workers
     chunk = coll.chunk_size(plan.total, n_dev)
 
     def step(params, opt: ShardedAdam, tokens, targets, weights):
         t_local = tokens.shape[1]
-        offset = lax.axis_index(SP_AXIS) * t_local
+        pos = _shard_positions(config, t_local)
 
         def local_loss(p):
             num, den = transformer.lm_loss_sums(
                 p, tokens, targets, weights, config.spec, attn_fn=attn,
-                pos_offset=offset, compute_dtype=config.dtype(),
+                positions=pos, compute_dtype=config.dtype(),
             )
             return num / lax.psum(den, AXES)
 
@@ -271,12 +299,12 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan):
     return step
 
 
-def _step_body(config: SeqConfig):
+def _step_body(config: SeqConfig, platform: str | None = None):
     """One train step, already inside ``shard_map``: global weighted-CE
     loss, grads for the replicated params (``shard_map`` transposes the
     replicated in_spec with an automatic cotangent ``psum`` — the pattern
     pinned against the oracle by tests/test_lm.py), TF1-Adam update."""
-    loss_sums = _shard_sums(config, transformer.lm_loss_sums)
+    loss_sums = _shard_sums(config, transformer.lm_loss_sums, platform)
 
     def loss(params, tokens, targets, weights):
         num, den = loss_sums(params, tokens, targets, weights)
@@ -314,16 +342,38 @@ class SeqTrainer:
                 f"ulysses needs num_heads ({config.spec.num_heads}) "
                 f"divisible by num_workers ({W})"
             )
-        if dataset.tokens.max() >= config.spec.vocab:
-            raise ValueError(
-                f"dataset vocab {dataset.tokens.max() + 1} exceeds model "
-                f"vocab {config.spec.vocab}"
-            )
+        # BOTH splits checked: JAX clamps out-of-range gather indices
+        # instead of erroring, so test ids >= vocab would silently read
+        # wrong embedding rows and skew eval (round-4 advisor).
+        for name, toks in (("train", dataset.tokens),
+                           ("test", dataset.test_tokens)):
+            if toks.size and toks.max() >= config.spec.vocab:
+                raise ValueError(
+                    f"{name} vocab {toks.max() + 1} exceeds model "
+                    f"vocab {config.spec.vocab}"
+                )
         if config.batch_size % max(dp, 1):
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by "
                 f"data_parallel {dp} (the batch shards over dp rows)"
             )
+        if dataset.num_train // config.batch_size == 0:
+            raise ValueError(
+                f"batch_size {config.batch_size} exceeds "
+                f"{dataset.num_train} train sequences"
+            )
+        if config.seq_layout == "zigzag":
+            if config.scheme != "ring":
+                raise ValueError(
+                    "seq_layout='zigzag' balances the RING's causal sweep; "
+                    "full/ulysses reassemble the whole sequence locally and "
+                    "assume contiguous order — use scheme='ring'"
+                )
+            if dataset.seq_len % (2 * W):
+                raise ValueError(
+                    f"seq_layout='zigzag' needs seq_len % (2 * num_workers)"
+                    f" == 0, got {dataset.seq_len} % {2 * W}"
+                )
         if dp < 1 or W < 1:
             raise ValueError(
                 f"data_parallel ({dp}) and num_workers ({W}) must be >= 1"
@@ -337,6 +387,17 @@ class SeqTrainer:
         self.config = config
         self.dataset = dataset
         self.mesh = make_mesh_2d(dp, W)
+        # Kernel selection (flash vs reference twin) follows where the
+        # program actually runs, not the default backend (round-4 advisor).
+        self._platform = self.mesh.devices.flat[0].platform
+        # Zigzag: one staging-time gather re-orders the sequence dim so
+        # contiguous sp sharding lands chunk pair (i, 2P-1-i) on device i;
+        # _shard_positions hands RoPE/masking the matching absolute
+        # positions. None = contiguous (identity).
+        self._perm = (
+            ring.zigzag_permutation(W, dataset.seq_len)
+            if config.seq_layout == "zigzag" else None
+        )
         # multihost.put_tree: plain device_put single-process; in a
         # multi-process world every controller materializes the same
         # deterministic init and the global replicated Array is assembled
@@ -378,7 +439,7 @@ class SeqTrainer:
         if self.config.zero1:
             opt_spec = ShardedAdam(step=P(), m=P(AXES), v=P(AXES))
             shard_step = jax.shard_map(
-                _zero1_step_body(self.config, self._plan),
+                _zero1_step_body(self.config, self._plan, self._platform),
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, seq, seq, seq),
                 out_specs=(P(), opt_spec, P()),
@@ -389,7 +450,7 @@ class SeqTrainer:
             )
         else:
             shard_step = jax.shard_map(
-                _step_body(self.config),
+                _step_body(self.config, self._platform),
                 mesh=self.mesh,
                 in_specs=(P(), P(), seq, seq, seq),
                 out_specs=(P(), P(), P()),
@@ -414,7 +475,8 @@ class SeqTrainer:
 
     def _eval_fn(self):
         sums = jax.shard_map(
-            _shard_sums(self.config, transformer.lm_correct_sums),
+            _shard_sums(self.config, transformer.lm_correct_sums,
+                        self._platform),
             mesh=self.mesh,
             in_specs=(P(), P(None, SP_AXIS), P(None, SP_AXIS),
                       P(None, SP_AXIS)),
@@ -427,8 +489,16 @@ class SeqTrainer:
 
         return jax.jit(acc)
 
+    def _permuted(self, arr: np.ndarray) -> np.ndarray:
+        """Apply the layout's sequence permutation (identity when
+        contiguous) — tokens/targets/weights all move together, so the
+        loss mask follows its tokens."""
+        return arr if self._perm is None else arr[:, self._perm]
+
     def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
-        shaped = arr[: batches * bs].reshape(batches, bs, arr.shape[1])
+        shaped = self._permuted(arr[: batches * bs]).reshape(
+            batches, bs, arr.shape[1]
+        )
         return multihost.put(self.mesh, P(None, DP_AXIS, SP_AXIS), shaped)
 
     # -- checkpoint form (elastic: params-shaped m/v in BOTH modes) --------
@@ -506,17 +576,19 @@ class SeqTrainer:
         cfg = self.config
         ds = self.dataset
         bs = cfg.batch_size
+        # batch_size vs num_train is validated in __init__ (every config
+        # pre-flight lives there, so the CLI's ValueError guard can wrap
+        # construction only — round-4 advisor).
         batch_num = ds.num_train // bs
-        if batch_num == 0:
-            raise ValueError(
-                f"batch_size {bs} exceeds {ds.num_train} train sequences"
-            )
         xs = self._stage(ds.tokens, batch_num, bs)
         ys = self._stage(ds.targets, batch_num, bs)
         ws = self._stage(ds.weights, batch_num, bs)
-        xte = multihost.put(self.mesh, self._seq_spec(2), ds.test_tokens)
-        yte = multihost.put(self.mesh, self._seq_spec(2), ds.test_targets)
-        wte = multihost.put(self.mesh, self._seq_spec(2), ds.test_weights)
+        put_test = lambda a: multihost.put(
+            self.mesh, self._seq_spec(2), self._permuted(a)
+        )
+        xte = put_test(ds.test_tokens)
+        yte = put_test(ds.test_targets)
+        wte = put_test(ds.test_weights)
         # Fresh buffers: the span programs donate params/opt (on TPU),
         # which must never consume the trainer's own state.
         params = jax.tree.map(jnp.copy, self.params)

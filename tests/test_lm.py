@@ -408,6 +408,61 @@ def test_seq_trainer_2d_rejects_indivisible_batch():
         )
 
 
+def test_seq_trainer_zigzag_matches_contiguous():
+    """seq_layout='zigzag' is the same computation re-placed: identical
+    trainings (ring, W=8) agree with the contiguous layout in final
+    loss/params to attention-reassociation tolerance, and the copy task
+    still trains (the permuted loss mask follows its tokens). Also
+    composes with zero1."""
+    ds = synthesize_copy(
+        num_train=64, num_test=32, seq_len=T, vocab=SPEC.vocab, seed=16
+    )
+    base = dict(epochs=2, batch_size=16, learning_rate=1e-3, eval_every=0,
+                num_workers=8, scheme="ring", spec=SPEC, seed=9)
+    cont = SeqTrainer(SeqConfig(**base), ds).train(log=lambda s: None)
+    zz = SeqTrainer(
+        SeqConfig(seq_layout="zigzag", **base), ds
+    ).train(log=lambda s: None)
+    assert np.isclose(zz.final_loss, cont.final_loss, rtol=1e-3), (
+        zz.final_loss, cont.final_loss
+    )
+    assert abs(zz.final_accuracy - cont.final_accuracy) < 0.02
+    for a, b in zip(jax.tree.leaves(cont.params), jax.tree.leaves(zz.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-3
+        )
+    zz1 = SeqTrainer(
+        SeqConfig(seq_layout="zigzag", zero1=True, **base), ds
+    ).train(log=lambda s: None)
+    assert np.isclose(zz1.final_loss, cont.final_loss, rtol=1e-3)
+
+
+def test_seq_trainer_zigzag_rejects_bad_configs():
+    ds = synthesize_copy(num_train=8, num_test=4, seq_len=32, vocab=16,
+                         seed=0)
+    with pytest.raises(ValueError, match="ring"):
+        SeqTrainer(
+            SeqConfig(num_workers=2, scheme="ulysses", seq_layout="zigzag",
+                      spec=SPEC), ds
+        )
+    ds24 = synthesize_copy(num_train=8, num_test=4, seq_len=24, vocab=16,
+                           seed=0)
+    with pytest.raises(ValueError, match="zigzag"):
+        SeqTrainer(
+            SeqConfig(num_workers=8, scheme="ring", seq_layout="zigzag",
+                      spec=SPEC), ds24
+        )  # 24 % 8 == 0 but 24 % 16 != 0 — only zigzag rejects
+    big_test = synthesize_copy(num_train=8, num_test=4, seq_len=32, vocab=16,
+                               seed=0)
+    # Test-split vocab overflow is caught too (JAX clamps gathers
+    # silently — round-4 advisor): corrupt ONLY the test tokens.
+    big_test.test_tokens[0, 0] = SPEC.vocab
+    with pytest.raises(ValueError, match="test vocab"):
+        SeqTrainer(SeqConfig(num_workers=8, spec=SPEC), big_test)
+    with pytest.raises(ValueError, match="exceeds"):
+        SeqTrainer(SeqConfig(num_workers=8, batch_size=64, spec=SPEC), ds)
+
+
 def test_flash_attention_matches_oracle():
     """ops/attention.py off-TPU routes the kernel's pure-JAX reference —
     fwd and grads must match the repo oracle (the TPU Pallas kernel is

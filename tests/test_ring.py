@@ -263,6 +263,147 @@ def test_ring_attention_longer_kv_causal():
     np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
 
 
+def _zigzag_shard_out(q, k, v, *, nsub=None):
+    """Run the zigzag-layout ring on zigzag-permuted inputs; return the
+    output mapped back to natural order (global [B, T, H, D])."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    perm = ring.zigzag_permutation(8, q.shape[1])
+    inv = np.argsort(perm)
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring.ring_attention_shard(
+                q, k, v, axis_name=DP_AXIS, axis_size=8, causal=True,
+                layout="zigzag", **({} if nsub is None else {"nsub": nsub}),
+            ),
+            mesh=mesh,
+            in_specs=(P(None, DP_AXIS),) * 3,
+            out_specs=P(None, DP_AXIS),
+        )
+    )(q[:, perm], k[:, perm], v[:, perm])
+    return np.asarray(out)[:, inv]
+
+
+def test_ring_attention_zigzag_matches_oracle():
+    """The balanced two-ended layout is EXACT: zigzag-permuted inputs
+    through layout='zigzag' (default nsub=2 sub-tile skipping) reproduce
+    the contiguous oracle after mapping back to natural order."""
+    q, k, v = _qkv(seed=10)
+    out = _zigzag_shard_out(q, k, v)
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, np.asarray(expect), atol=2e-4)
+
+
+def test_ring_attention_zigzag_nsub1_matches_oracle():
+    """nsub is a skip granularity, never a numerics knob: zigzag at tile
+    granularity (nothing skips — every tile holds some unmasked work)
+    equals the oracle too."""
+    q, k, v = _qkv(seed=11)
+    out = _zigzag_shard_out(q, k, v, nsub=1)
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, np.asarray(expect), atol=2e-4)
+
+
+def test_ring_attention_zigzag_grads_match_oracle():
+    """Gradients flow through the sub-tile conds and the travelling
+    positions: d/dq,k,v of a zigzag ring loss == the oracle's grads."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    q, k, v = _qkv(seed=12)
+    perm = ring.zigzag_permutation(8, T)
+    inv = np.argsort(perm)
+
+    smapped = jax.shard_map(
+        lambda q, k, v: ring.ring_attention_shard(
+            q, k, v, axis_name=DP_AXIS, axis_size=8, causal=True,
+            layout="zigzag",
+        ),
+        mesh=mesh,
+        in_specs=(P(None, DP_AXIS),) * 3,
+        out_specs=P(None, DP_AXIS),
+    )
+
+    def loss_zz(q, k, v):
+        return (smapped(q[:, perm], k[:, perm], v[:, perm]) ** 2).sum()
+
+    def loss_oracle(q, k, v):
+        return (ring.full_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_zz = jax.grad(loss_zz, argnums=(0, 1, 2))(q, k, v)
+    g_or = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for gr, go in zip(g_zz, g_or):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(go), atol=5e-3, rtol=1e-3
+        )
+
+
+def test_contiguous_nsub2_matches_oracle():
+    """The generalized sub-tile loop is layout-independent: contiguous
+    layout at nsub=2 (finer skip granularity) equals the oracle."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import DP_AXIS
+
+    mesh = make_mesh(8)
+    q, k, v = _qkv(seed=13)
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring.ring_attention_shard(
+                q, k, v, axis_name=DP_AXIS, axis_size=8, causal=True, nsub=2
+            ),
+            mesh=mesh,
+            in_specs=(P(None, DP_AXIS),) * 3,
+            out_specs=P(None, DP_AXIS),
+        )
+    )(q, k, v)
+    expect = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), expect, atol=2e-4)
+
+
+def test_zigzag_permutation_matches_positions():
+    """The staging gather and the in-shard position math are the same
+    layout: slot t of the permuted sequence holds original position
+    zigzag_positions(t // t_local)[t % t_local] — if these ever diverge,
+    RoPE and the causal mask would disagree with the data movement."""
+    P_, Tn = 8, 64
+    perm = ring.zigzag_permutation(P_, Tn)
+    t_local = Tn // P_
+    for i in range(P_):
+        np.testing.assert_array_equal(
+            perm[i * t_local:(i + 1) * t_local],
+            np.asarray(ring.zigzag_positions(i, P_, t_local)),
+        )
+    # A permutation (bijective), and device 0 holds both sequence ends.
+    assert sorted(perm.tolist()) == list(range(Tn))
+    assert perm[0] == 0 and perm[t_local - 1] == Tn - 1
+
+
+def test_causal_work_profile_zigzag_is_balanced():
+    """The analytic work model (same skip rule as the runtime lax.cond):
+    contiguous leaves device P-1 computing a full tile on EVERY ring step
+    (critical path = P tiles) while zigzag spreads the causal triangle —
+    every device does the same total and the critical path halves."""
+    P_ = 8
+    cont = ring.causal_work_profile(P_, "contiguous")
+    zz = ring.causal_work_profile(P_, "zigzag")
+    # Per-device totals: contiguous spans 1..P tiles; zigzag is EXACTLY
+    # balanced at (2P+1)/4 per device.
+    assert cont.sum(axis=1).max() == P_ and cont.sum(axis=1).min() == 1
+    np.testing.assert_allclose(zz.sum(axis=1), (2 * P_ + 1) / 4)
+    # Lockstep critical path: sum over steps of the busiest device.
+    crit_cont = cont.max(axis=0).sum()
+    crit_zz = zz.max(axis=0).sum()
+    assert crit_cont == P_
+    assert crit_zz == (2 * P_ + 1) / 4
+    assert crit_zz < 0.6 * crit_cont
+
+
 def test_ring_attention_custom_striped_positions():
     """Explicit qpos/kpos: a strided layout (device i holds positions
     i, i+8, i+16, ...) must reproduce the oracle — pins that kpos
