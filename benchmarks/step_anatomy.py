@@ -135,6 +135,20 @@ def main() -> None:
             params, x, compute_dtype=jnp.bfloat16, first_conv_matmul=True
         )
 
+    def fwd_tailmm(params, x):
+        # Convs 3-4 (7x7 / 4x4 spatial) as patches-matmuls — the round-4
+        # fit attributes the ~2ms batch-independent term to the small
+        # conv kernels; this decides whether deep MXU matmuls beat the
+        # conv lowering's fixed cost there (round-4 verdict task 2).
+        return cnn.apply_fn(
+            params, x, compute_dtype=jnp.bfloat16, conv_matmul="tail"
+        )
+
+    def fwd_allmm(params, x):
+        return cnn.apply_fn(
+            params, x, compute_dtype=jnp.bfloat16, conv_matmul="all"
+        )
+
     def fwd_drop(params, x, rng):
         return cnn.apply_fn(
             params, x, dropout_rng=rng, compute_dtype=jnp.bfloat16
@@ -164,6 +178,8 @@ def main() -> None:
         for name, fn, a in (
             ("fwd", fwd, (params, xb)),
             ("fwd_patches", fwd_patches, (params, xb)),
+            ("fwd_tailmm", fwd_tailmm, (params, xb)),
+            ("fwd_allmm", fwd_allmm, (params, xb)),
             ("fwd_drop", fwd_drop, (params, xb, rng)),
             ("grad", gradp, (params, xb, yb, rng)),
         ):
@@ -173,6 +189,17 @@ def main() -> None:
         )
         rows["step"] = timed(
             step, (params, opt, xb, yb, rng), iters=args.iters,
+            repeats=args.repeats,
+        )
+        # The full product step with the tail convs as matmuls — the
+        # head-to-head that decides whether --conv-matmul tail becomes
+        # the recommended configuration.
+        step_tail = make_train_step(
+            TrainConfig(batch_size=b, compute_dtype="bfloat16",
+                        conv_matmul="tail")
+        )
+        rows["step_tailmm"] = timed(
+            step_tail, (params, opt, xb, yb, rng), iters=args.iters,
             repeats=args.repeats,
         )
         report["pieces"][b] = {k: round(v * 1e6, 1) for k, v in rows.items()}

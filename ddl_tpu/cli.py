@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "explicit patches-matmul (MXU lane utilization; "
                         "1e-5-level numerics difference — measured vs the "
                         "conv lowering by benchmarks/step_anatomy.py)")
+    p.add_argument("--conv-matmul", default="none",
+                   choices=["none", "first", "tail", "first+tail", "all"],
+                   help="which conv stages run as explicit patches-matmuls: "
+                        "first (= --conv1-matmul), tail (convs 3-4 — the "
+                        "small-spatial stages whose conv-kernel fixed cost "
+                        "dominates small-batch step time), all; measured "
+                        "head-to-head by benchmarks/step_anatomy.py")
     p.add_argument("--conv-channels", type=_int_tuple, default=None,
                    metavar="C1,C2,C3,C4",
                    help="conv widths of the model family (default "
@@ -309,6 +316,7 @@ def config_from_args(args) -> "TrainConfig":
         compute_dtype=_resolve_dtype(args),
         fused_adam=args.fused_adam,
         conv1_matmul=args.conv1_matmul,
+        conv_matmul=args.conv_matmul,
         conv_channels=conv_channels or (32, 64, 128, 256),
         fc_sizes=fc_sizes or (1024, 512),
     )
@@ -398,8 +406,8 @@ def _run_lm(args) -> int:
     defaults = build_parser()
     for dest in ("num_ps", "layout", "keep_prob", "staleness_seed", "data",
                  "synthetic_train", "synthetic_test", "fused_adam",
-                 "conv1_matmul", "conv_channels", "fc_sizes", "tiny",
-                 "reference_compat"):
+                 "conv1_matmul", "conv_matmul", "conv_channels", "fc_sizes",
+                 "tiny", "reference_compat"):
         if getattr(args, dest) != defaults.get_default(dest):
             raise SystemExit(
                 f"--{dest.replace('_', '-')} does not apply to the lm variant"

@@ -151,23 +151,34 @@ def _conv_block(x: jax.Array, w: jax.Array, b: jax.Array, precision) -> jax.Arra
 def _patches_block(
     x: jax.Array, w: jax.Array, b: jax.Array, precision
 ) -> jax.Array:
-    """The first conv block re-expressed as patches @ matmul.
+    """A conv block re-expressed as patches @ matmul (any cin).
 
-    The first conv has ONE input channel, so its contraction depth is
-    kh*kw*cin = 25 — a fraction of the MXU's 128 reduction lanes when
-    lowered as a convolution (round-3 verdict weak #3: "MXU lane waste").
-    Extracting the 5x5 patches explicitly turns it into a single
-    ``[N*784, 25] @ [25, 32]`` matmul XLA can tile like the FC layers.
+    Two distinct hardware motives, selected per stage by ``conv_matmul``:
+
+    - **first** (cin=1): contraction depth kh*kw*cin = 25 — a fraction of
+      the MXU's 128 reduction lanes when lowered as a convolution
+      (round-3 verdict weak #3: "MXU lane waste"). As a matmul it is
+      ``[N*784, 25] @ [25, 32]``, tiled like the FC layers.
+    - **tail** (convs 3-4, spatial 7x7 and 4x4): the round-4 step-time
+      fit puts a ~2ms batch-independent term inside the conv+pool+bwd
+      kernel sequence; the small-spatial stages are where a conv
+      kernel's fixed cost cannot amortize. As matmuls they are
+      ``[N*49, 1600] @ [1600, 128]`` / ``[N*16, 3200] @ [3200, 256]`` —
+      deep, MXU-shaped contractions (round-4 verdict task 2).
+
     Bit-identical contraction order is NOT guaranteed vs the conv
-    lowering (tests pin 1e-5 agreement); selected via
-    ``apply_fn(first_conv_matmul=True)`` so the two paths are measured
-    against each other on hardware (benchmarks/step_anatomy.py) rather
-    than guessed at.
+    lowering (tests pin 1e-5 agreement); selected per stage via
+    ``apply_fn(conv_matmul=...)`` so the paths are measured against each
+    other on hardware (benchmarks/step_anatomy.py) rather than guessed
+    at. Cost: the patch tensor materializes kh*kw = 25x the input
+    activations for that stage — cheap at 7x7/4x4 spatial, significant
+    if ever applied at 28x28 with many channels.
     """
     n, h, ww, _ = x.shape
+    kh, kw = w.shape[:2]
     patches = lax.conv_general_dilated_patches(
         x,
-        filter_shape=(5, 5),
+        filter_shape=(kh, kw),
         window_strides=(1, 1),
         padding="SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -179,6 +190,16 @@ def _patches_block(
         patches.reshape(n * h * ww, -1), wmat, precision=precision
     ).reshape(n, h, ww, cout)
     return _pool(jax.nn.relu(y + b))
+
+
+# Which conv stages run as patches-matmul, per mode (index = stage).
+CONV_MATMUL_MODES: dict[str, tuple[bool, bool, bool, bool]] = {
+    "none": (False, False, False, False),
+    "first": (True, False, False, False),     # the cin=1 MXU-lane case
+    "tail": (False, False, True, True),       # the small-spatial stages
+    "first+tail": (True, False, True, True),  # both measured wins combined
+    "all": (True, True, True, True),
+}
 
 
 def _dropout(
@@ -202,6 +223,7 @@ def apply_fn(
     compute_dtype=None,
     precision: lax.Precision | None = None,
     first_conv_matmul: bool = False,
+    conv_matmul: str | None = None,
 ) -> jax.Array:
     """Forward pass: ``[N, 784]`` -> fp32 logits ``[N, 10]``.
 
@@ -210,18 +232,23 @@ def apply_fn(
     ``tf.nn.dropout`` calls (model.py:74,82). ``precision=None`` keeps the
     backend default (MXU-friendly); pass ``lax.Precision.HIGHEST`` for
     strict fp32 accumulation (used by the parity tests).
-    ``first_conv_matmul`` routes the 1-input-channel first conv through an
-    explicit patches-matmul (see :func:`_patches_block`).
+    ``conv_matmul`` selects which conv stages run as explicit
+    patches-matmuls (:data:`CONV_MATMUL_MODES`: none/first/tail/all —
+    see :func:`_patches_block` for the hardware motives);
+    ``first_conv_matmul=True`` is the pre-existing alias for "first".
     """
+    if conv_matmul is None:
+        conv_matmul = "first" if first_conv_matmul else "none"
+    as_matmul = CONV_MATMUL_MODES[conv_matmul]
     if compute_dtype is not None:
         params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
         x = x.astype(compute_dtype)
     h = x.reshape(-1, 28, 28, 1)  # model.py:19
-    block1 = _patches_block if first_conv_matmul else _conv_block
-    h = block1(h, params["v0"], params["v1"], precision)
-    h = _conv_block(h, params["v2"], params["v3"], precision)
-    h = _conv_block(h, params["v4"], params["v5"], precision)
-    h = _conv_block(h, params["v6"], params["v7"], precision)
+    for stage, (wn, bn) in enumerate(
+        (("v0", "v1"), ("v2", "v3"), ("v4", "v5"), ("v6", "v7"))
+    ):
+        block = _patches_block if as_matmul[stage] else _conv_block
+        h = block(h, params[wn], params[bn], precision)
     h = h.reshape(h.shape[0], params["v8"].shape[0])  # model.py:69 (2*2*c4)
     mm = lambda a, b: jnp.matmul(a, b, precision=precision)
     h = jax.nn.relu(mm(h, params["v8"]) + params["v9"])
@@ -246,6 +273,7 @@ def loss_fn(
     compute_dtype=None,
     precision: lax.Precision | None = None,
     first_conv_matmul: bool = False,
+    conv_matmul: str | None = None,
 ) -> jax.Array:
     """Mean softmax cross-entropy (model.py:91-92)."""
     logits = apply_fn(
@@ -256,6 +284,7 @@ def loss_fn(
         compute_dtype=compute_dtype,
         precision=precision,
         first_conv_matmul=first_conv_matmul,
+        conv_matmul=conv_matmul,
     )
     logprobs = jax.nn.log_softmax(logits)
     return -jnp.mean(jnp.sum(y_onehot * logprobs, axis=-1))

@@ -172,7 +172,7 @@ def make_async_round(
             dropout_rng=rng if config.keep_prob < 1.0 else None,
             keep_prob=config.keep_prob,
             compute_dtype=compute_dtype,
-            first_conv_matmul=config.conv1_matmul,
+            conv_matmul=config.conv_matmul_mode(),
         )
         return loss, coll.flatten_params(grads, spec)
 

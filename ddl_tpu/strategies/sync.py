@@ -126,7 +126,7 @@ def _local_grads(config: TrainConfig, params, x, y, rng, axis: str):
         dropout_rng=rng if config.keep_prob < 1.0 else None,
         keep_prob=config.keep_prob,
         compute_dtype=compute_dtype,
-        first_conv_matmul=config.conv1_matmul,
+        conv_matmul=config.conv_matmul_mode(),
     )
     return loss, grads
 

@@ -70,6 +70,30 @@ class TrainConfig:
     # numerics difference vs the conv lowering (contraction order).
     conv1_matmul: bool = False
 
+    # Which conv stages run as explicit patches-matmuls
+    # (models/cnn.py CONV_MATMUL_MODES): "none" (conv lowering
+    # everywhere), "first" (≡ conv1_matmul), "tail" (convs 3-4 — the
+    # 7x7/4x4 small-spatial stages where a conv kernel's fixed cost
+    # cannot amortize; the round-4 step-time fit attributes the ~2ms
+    # batch-independent term to this kernel sequence), "first+tail",
+    # or "all". "none" defers to the conv1_matmul flag for back-compat.
+    conv_matmul: Literal["none", "first", "tail", "first+tail", "all"] = \
+        "none"
+
+    def conv_matmul_mode(self) -> str:
+        """The effective patches-matmul selection; trainers pass this to
+        ``cnn.apply_fn``. The conv1_matmul alias COMPOSES with the mode
+        (--conv1-matmul --conv-matmul tail means first+tail — silently
+        dropping the first-conv request would mislabel a measurement;
+        review finding r5)."""
+        mode = self.conv_matmul
+        if self.conv1_matmul:
+            if mode == "none":
+                return "first"
+            if mode == "tail":
+                return "first+tail"
+        return mode
+
     # Early stop: end training at the first eval whose full-test-set
     # accuracy reaches this target (None = run all epochs). Evals happen
     # every ``eval_every`` batches — that is the detection granularity.

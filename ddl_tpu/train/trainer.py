@@ -66,7 +66,7 @@ def make_train_step(
             dropout_rng=rng,
             keep_prob=config.keep_prob,
             compute_dtype=compute_dtype,
-            first_conv_matmul=config.conv1_matmul,
+            conv_matmul=config.conv_matmul_mode(),
         )
         params, opt_state = adam_update(
             params, opt_state, grads, lr=config.learning_rate

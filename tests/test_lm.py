@@ -463,6 +463,38 @@ def test_seq_trainer_zigzag_rejects_bad_configs():
         SeqTrainer(SeqConfig(num_workers=8, batch_size=64, spec=SPEC), ds)
 
 
+def test_seq_trainer_activation_memory_scales_with_shard():
+    """The product-level memory law (the op-level twin is
+    test_ring_attention_memory_is_blockwise): the COMPILED span program's
+    per-device temp memory — activations, ring tiles, and the autodiff
+    residuals XLA saves across the ring steps — must shrink as the same
+    global sequence shards over more devices. At fixed global tokens the
+    dominant saved-residual term is W tiles of (T/W)^2 = O(T^2/W), so
+    widening W=2 -> W=8 must cut per-device temp by ~4x; require >3x so
+    the bound survives fusion/layout drift without going stale."""
+    import jax.numpy as jnp
+
+    def temp_bytes(W):
+        T_ = 1024
+        ds = synthesize_copy(
+            num_train=4, num_test=2, seq_len=T_, vocab=SPEC.vocab, seed=20
+        )
+        tr = SeqTrainer(
+            SeqConfig(num_workers=W, scheme="ring", batch_size=4, spec=SPEC),
+            ds,
+        )
+        xs = tr._stage(ds.tokens, 1, 4)
+        ys = tr._stage(ds.targets, 1, 4)
+        ws = tr._stage(ds.weights, 1, 4)
+        c = tr._span_fn(1).lower(
+            tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0)
+        ).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    t2, t8 = temp_bytes(2), temp_bytes(8)
+    assert t2 > 3 * t8, (t2, t8)
+
+
 def test_flash_attention_matches_oracle():
     """ops/attention.py off-TPU routes the kernel's pure-JAX reference —
     fwd and grads must match the repo oracle (the TPU Pallas kernel is

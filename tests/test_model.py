@@ -153,3 +153,34 @@ def test_first_conv_matmul_matches_conv():
                       precision=lax.Precision.HIGHEST,
                       first_conv_matmul=True)
     np.testing.assert_allclose(np.asarray(at), np.asarray(bt), atol=1e-5)
+
+
+def test_conv_matmul_modes_match_conv():
+    """Every patches-matmul mode (first/tail/all — any cin, any spatial
+    size, cnn.CONV_MATMUL_MODES) reproduces the conv lowering's logits,
+    fwd AND grad — the numerics contract behind --conv-matmul."""
+    from jax import lax
+
+    params = cnn.init_params(jax.random.PRNGKey(8))
+    x = jax.random.uniform(jax.random.PRNGKey(9), (8, 784))
+    y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+    ref = cnn.apply_fn(params, x, precision=lax.Precision.HIGHEST)
+    g_ref = jax.grad(cnn.loss_fn)(
+        params, x, y, dropout_rng=None, precision=lax.Precision.HIGHEST
+    )
+    for mode in ("first", "tail", "first+tail", "all"):
+        got = cnn.apply_fn(
+            params, x, precision=lax.Precision.HIGHEST, conv_matmul=mode
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, err_msg=mode
+        )
+        g = jax.grad(cnn.loss_fn)(
+            params, x, y, dropout_rng=None,
+            precision=lax.Precision.HIGHEST, conv_matmul=mode,
+        )
+        for k in g_ref:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(g_ref[k]),
+                atol=2e-5, rtol=1e-4, err_msg=f"{mode}:{k}",
+            )
