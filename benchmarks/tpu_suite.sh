@@ -10,11 +10,16 @@
 #   sh benchmarks/tpu_suite.sh
 #
 # Rows produced:
-#   bench_tpu.json        headline sweep + sync W=1 (bench.py)
-#   adam_kernel_tpu.json  fused Pallas Adam vs XLA-fused chain
-#   tta_<variant>.json    time-to-target-accuracy, W=1 product trainers
-#                         (multi-worker variants are CPU-proxied in
-#                         scaling.json — one real chip here)
+#   bench_tpu.json          headline sweep + sync W=1 (bench.py)
+#   lm_tpu.json             long-context LM tokens/s + MFU, xla vs flash
+#   step_anatomy_tpu.json   per-piece fixed-cost attribution incl. the
+#                           tail-matmul conv lowering head-to-head
+#   bench_tpu_tailmm.json   the headline sweep re-run with
+#                           BENCH_CONV_MATMUL=tail (comparison record)
+#   adam_kernel_tpu.json    fused Pallas Adam vs XLA-fused chain
+#   tta_<variant>.json      time-to-target-accuracy, W=1 product trainers
+#                           (multi-worker variants are CPU-proxied in
+#                           scaling.json — one real chip here)
 set -ex
 cd "$(dirname "$0")/.."
 R=benchmarks/results
@@ -37,6 +42,28 @@ sys.exit(0 if ok else 1)
 BENCH_PROBE_WINDOW_S="${BENCH_INNER_WINDOW_S:-600}" \
   python bench.py >"$R/bench_tpu.json.tmp" 2>"$R/bench_tpu.log"
 mv "$R/bench_tpu.json.tmp" "$R/bench_tpu.json"
+
+# First hardware run of the long-context LM set: tokens/s + MFU over
+# seq 512-4096, xla einsum vs the Pallas flash kernel (round-4 verdict
+# task 1b — the flash TPU branch has never executed on hardware).
+python benchmarks/lm_bench.py --json "$R/lm_tpu.json.tmp" \
+  2>"$R/lm_tpu.log"
+mv "$R/lm_tpu.json.tmp" "$R/lm_tpu.json"
+
+# Conv lowering head-to-head on the chip (round-4 verdict task 2): the
+# full product step with the tail convs as matmuls vs the conv kernels,
+# plus the per-piece attribution of the ~2ms fixed term.
+python benchmarks/step_anatomy.py --json "$R/step_anatomy_tpu.json.tmp" \
+  2>"$R/step_anatomy_tpu.log"
+mv "$R/step_anatomy_tpu.json.tmp" "$R/step_anatomy_tpu.json"
+
+# The headline sweep is ALSO recorded with the tail convs as matmuls —
+# unconditionally, so the conv-lowering comparison exists at every batch
+# size whichever way step_anatomy's pieces point (bench_tpu.json stays
+# the product-default record; compare the two files offline).
+BENCH_PROBE_WINDOW_S="${BENCH_INNER_WINDOW_S:-600}" BENCH_CONV_MATMUL=tail \
+  python bench.py >"$R/bench_tpu_tailmm.json.tmp" 2>"$R/bench_tpu_tailmm.log"
+mv "$R/bench_tpu_tailmm.json.tmp" "$R/bench_tpu_tailmm.json"
 
 python benchmarks/adam_kernel.py --json "$R/adam_kernel_tpu.json.tmp" \
   2>"$R/adam_kernel_tpu.log"

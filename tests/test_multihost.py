@@ -371,3 +371,35 @@ def test_two_process_lm_world_trains_end_to_end():
     assert payloads[0]["final_accuracy"] == payloads[1]["final_accuracy"]
     assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
     assert payloads[0]["config"]["scheme"] == "ring"
+
+
+def test_two_process_lm_world_zigzag_matches_contiguous():
+    """The balanced zigzag layout across a REAL two-process world: the
+    travelling kpos crosses the OS-process boundary with its K/V block,
+    and the permuted staging happens per-controller — the run must agree
+    with the contiguous-layout world on the same config (same math,
+    different placement; attention-reassociation tolerance)."""
+    results = {}
+    for layout in ("contiguous", "zigzag"):
+        port = multihost.free_port()
+        common = [
+            sys.executable, "-m", "ddl_tpu", "lm", "--multihost",
+            "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+            "--platform", "cpu", "--num-workers", "2", "--seq-scheme",
+            "ring", "--seq-layout", layout, "--seq-len", "32", "--vocab",
+            "16", "--d-model", "32", "--heads", "2", "--layers", "2",
+            "--d-ff", "64", "--train-seqs", "32", "--test-seqs", "16",
+            "--batch-size", "16", "--eval-every", "0", "--json",
+        ]
+        outs = _run_world(
+            [common + ["--process-id", str(i)] for i in (0, 1)], timeout=280
+        )
+        payloads = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+        assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
+        results[layout] = payloads[0]
+    assert np.isclose(
+        results["zigzag"]["final_loss"],
+        results["contiguous"]["final_loss"], rtol=1e-3,
+    ), results
+    assert abs(results["zigzag"]["final_accuracy"]
+               - results["contiguous"]["final_accuracy"]) < 0.05
