@@ -16,6 +16,8 @@
 #                           tail-matmul conv lowering head-to-head
 #   bench_tpu_tailmm.json   the headline sweep re-run with
 #                           BENCH_CONV_MATMUL=tail (comparison record)
+#   ring_balance_tpu.json   zigzag vs contiguous causal critical path
+#                           (1-chip device-role emulation, real kernels)
 #   adam_kernel_tpu.json    fused Pallas Adam vs XLA-fused chain
 #   tta_<variant>.json      time-to-target-accuracy, W=1 product trainers
 #                           (multi-worker variants are CPU-proxied in
@@ -37,10 +39,13 @@ ok = wait_backend(w, log=lambda m: print('[tpu_suite]', m, file=sys.stderr))
 sys.exit(0 if ok else 1)
 "
 
-# The suite gate above already waited; keep bench.py's inner window short
-# (mid-suite blip tolerance) instead of stacking another full window.
-BENCH_PROBE_WINDOW_S="${BENCH_INNER_WINDOW_S:-600}" \
-  python bench.py >"$R/bench_tpu.json.tmp" 2>"$R/bench_tpu.log"
+# The suite gate above already waited; cap EVERY tool's inner retry
+# window short (mid-suite blip tolerance) instead of stacking full
+# windows back to back — lm_bench/ring_balance/bench all read this.
+BENCH_PROBE_WINDOW_S="${BENCH_INNER_WINDOW_S:-600}"
+export BENCH_PROBE_WINDOW_S
+
+python bench.py >"$R/bench_tpu.json.tmp" 2>"$R/bench_tpu.log"
 mv "$R/bench_tpu.json.tmp" "$R/bench_tpu.json"
 
 # First hardware run of the long-context LM set: tokens/s + MFU over
@@ -61,9 +66,16 @@ mv "$R/step_anatomy_tpu.json.tmp" "$R/step_anatomy_tpu.json"
 # unconditionally, so the conv-lowering comparison exists at every batch
 # size whichever way step_anatomy's pieces point (bench_tpu.json stays
 # the product-default record; compare the two files offline).
-BENCH_PROBE_WINDOW_S="${BENCH_INNER_WINDOW_S:-600}" BENCH_CONV_MATMUL=tail \
+BENCH_CONV_MATMUL=tail \
   python bench.py >"$R/bench_tpu_tailmm.json.tmp" 2>"$R/bench_tpu_tailmm.log"
 mv "$R/bench_tpu_tailmm.json.tmp" "$R/bench_tpu_tailmm.json"
+
+# Zigzag-vs-contiguous causal critical path with real kernels (1-chip
+# device-role emulation — a W-device ring cannot run here, its lockstep
+# wall-clock model can; see ring_balance.py).
+python benchmarks/ring_balance.py --json "$R/ring_balance_tpu.json.tmp" \
+  2>"$R/ring_balance_tpu.log"
+mv "$R/ring_balance_tpu.json.tmp" "$R/ring_balance_tpu.json"
 
 python benchmarks/adam_kernel.py --json "$R/adam_kernel_tpu.json.tmp" \
   2>"$R/adam_kernel_tpu.log"
