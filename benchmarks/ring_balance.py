@@ -82,7 +82,13 @@ def main() -> None:
 
     P = args.workers
     T = args.seq_len
+    if T % P:
+        raise SystemExit(f"--seq-len {T} not divisible by --workers {P}")
     tl = T // P
+    if tl % 2:
+        raise SystemExit(
+            f"per-shard length {tl} must be even (zigzag sub-tiles)"
+        )
     B, H, D = args.batch, args.heads, args.head_dim
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -115,14 +121,19 @@ def main() -> None:
         return tiles
 
     _compiled: dict = {}
+    _timed: dict = {}
 
     def compiled_for(tiles, nsub):
         """One jitted+compiled scan program per DISTINCT mask pattern —
         ~15x fewer compiles than per-(role, step), which matters inside
-        the flaky tunnel window (review finding r5)."""
+        the flaky tunnel window (review finding r5). The measured time
+        is memoized under the same key (``cell_time``): identical key
+        means bit-identical executable, so re-timing a cell would
+        measure only noise — and summing max-over-roles of independently
+        re-sampled noise inflates the critical path."""
         key = (nsub, tuple((a, b, m.tobytes()) for a, b, m in tiles))
         if key in _compiled:
-            return _compiled[key]
+            return key, _compiled[key]
         nq = tl // nsub
         scale = 1.0 / np.sqrt(D)
 
@@ -164,9 +175,12 @@ def main() -> None:
         tok = c(jnp.float32(0))
         force(tok)  # warmup once per distinct program
         _compiled[key] = c
-        return c
+        return key, c
 
-    def timed(compiled) -> float:
+    def cell_time(tiles, nsub) -> float:
+        key, compiled = compiled_for(tiles, nsub)
+        if key in _timed:
+            return _timed[key]
         tok = compiled(jnp.float32(0))
         force(tok)
         best = float("inf")
@@ -175,6 +189,7 @@ def main() -> None:
             tok = compiled(tok)
             force(tok)
             best = min(best, (time.perf_counter() - t0) / args.iters)
+        _timed[key] = best
         return best
 
     report = {"metric": "ring_causal_critical_path",
@@ -185,8 +200,7 @@ def main() -> None:
         t = np.zeros((P, P))
         for i in range(P):
             for r in range(P):
-                tiles = step_pattern(layout, i, r, nsub)
-                t[i, r] = timed(compiled_for(tiles, nsub))
+                t[i, r] = cell_time(step_pattern(layout, i, r, nsub), nsub)
         crit = float(t.max(axis=0).sum())
         total = float(t.sum())
         analytic = causal_work_profile(P, layout)

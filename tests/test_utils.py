@@ -163,6 +163,33 @@ def test_probe_backend_subprocess_timeout_is_down():
     assert probe_backend_subprocess(timeout_s=0.05) == "down"
 
 
+def test_bench_cached_last_measured_reads_record(monkeypatch, tmp_path):
+    """bench.py's dead-tunnel JSON must carry the LAST REAL hardware
+    number, clearly labelled as a cache — and return None (never a
+    fabricated block) when no record exists or it is corrupt."""
+    import json
+
+    import bench
+
+    rec = {"value": 123456.7, "unit": "images/s", "batch": 2000,
+           "mfu_pct": 33.0, "vs_baseline": 300.0}
+    results = tmp_path / "benchmarks" / "results"
+    results.mkdir(parents=True)
+    (results / "bench_tpu.json").write_text(json.dumps(rec))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    got = bench.cached_last_measured()
+    assert got["value"] == 123456.7 and got["mfu_pct"] == 33.0
+    assert got["source"] == "benchmarks/results/bench_tpu.json"
+    assert "CACHED" in got["note"] and "NOT measured" in got["note"]
+    assert got["recorded_utc"].endswith("Z")
+    # Corrupt record -> None, not an exception (the error JSON must
+    # still be emitted inside the driver's timeout).
+    (results / "bench_tpu.json").write_text("{not json")
+    assert bench.cached_last_measured() is None
+    (results / "bench_tpu.json").unlink()
+    assert bench.cached_last_measured() is None
+
+
 def test_steps_scan_matches_lax_scan():
     """steps_scan's three regimes (k==1 inlined, k<=cap unrolled off-TPU,
     k>cap rolled) are all exactly lax.scan semantics: same carry, same
