@@ -33,7 +33,7 @@ from ddl_tpu.parallel.mesh import virtual_cpu_mesh  # noqa: E402
 
 
 def measure(seq_len: int, workers: int, layout: str, steps: int,
-            batch: int, spec) -> dict:
+            batch: int, spec, remat: bool = False) -> dict:
     import jax.numpy as jnp
 
     from ddl_tpu.data.lm import synthesize_copy
@@ -46,7 +46,7 @@ def measure(seq_len: int, workers: int, layout: str, steps: int,
     )
     cfg = SeqConfig(
         epochs=1, batch_size=batch, eval_every=0, num_workers=workers,
-        scheme="ring", seq_layout=layout, spec=spec,
+        scheme="ring", seq_layout=layout, remat=remat, spec=spec,
     )
     tr = SeqTrainer(cfg, ds)
     xs = tr._stage(ds.tokens, steps, batch)
@@ -66,6 +66,7 @@ def measure(seq_len: int, workers: int, layout: str, steps: int,
         "seq_len": seq_len,
         "workers": workers,
         "layout": layout,
+        "remat": remat,
         "tokens_per_sec": round(steps * batch * seq_len / dt, 1),
         "steps": steps,
         "loss": round(loss, 4),
@@ -91,11 +92,21 @@ def main() -> None:
     rows = [
         measure(args.seq_len, 8, "contiguous", args.steps, args.batch, spec),
         measure(args.seq_len, 8, "zigzag", args.steps, args.batch, spec),
+        # Remat: same loss, ~1/3 extra compute, saved-residual memory
+        # /100x (the framework-level number is pinned by
+        # tests/test_lm.py::test_seq_trainer_remat_same_numbers_less_memory;
+        # this row records the tokens/s COST of the trade end-to-end).
+        measure(args.seq_len, 8, "contiguous", args.steps, args.batch,
+                spec, remat=True),
         # The W=2 comparison point for the per-device memory law; one
         # step only (the quadratic score tiles make it the slow arm).
         measure(args.seq_len, 2, "contiguous", 1, args.batch, spec),
     ]
-    w8, w2 = rows[0], rows[2]
+    # Select by attributes, not position — inserting a row must not be
+    # able to silently re-point the ratio (review finding r5).
+    w8 = next(r for r in rows if r["workers"] == 8 and not r["remat"]
+              and r["layout"] == "contiguous")
+    w2 = next(r for r in rows if r["workers"] == 2)
     out = {
         "platform": "cpu-virtual-mesh",
         "spec": {"d_model": spec.d_model, "heads": spec.num_heads,

@@ -141,6 +141,7 @@ def apply_lm(
     pos_offset: int | jax.Array = 0,
     positions: jax.Array | None = None,
     compute_dtype=None,
+    remat: bool = False,
 ) -> jax.Array:
     """Forward pass: int tokens ``[B, T]`` -> fp32 logits ``[B, T, vocab]``.
 
@@ -153,6 +154,17 @@ def apply_lm(
     ``attn_fn`` performs (possibly cross-shard) attention on post-RoPE
     ``[B, T, H, D]`` q/k/v and owns causal masking — the model applies no
     mask itself.
+
+    ``remat=True`` wraps each block in ``jax.checkpoint``: the backward
+    pass recomputes the block — INCLUDING the cross-shard attention's
+    collective sweep (the ring's ppermute chain replays) — instead of
+    saving its residuals. This is the long-context memory lever: the
+    saved state per block drops from the attention residuals (the ring's
+    O((T/P)^2)-per-step tiles, O(T^2/P) per device across the sweep) to
+    the block INPUT (O(T/P · d_model)), at ~1/3 extra FLOPs (one extra
+    forward per block) — the standard remat trade
+    (jax-ml.github.io/scaling-book; measured by
+    tests/test_lm.py::test_seq_trainer_remat_*).
     """
     if compute_dtype is not None:
         params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
@@ -161,14 +173,21 @@ def apply_lm(
     if positions is None:
         positions = pos_offset + jnp.arange(t)
     heads = lambda a: a.reshape(b, t, spec.num_heads, spec.head_dim)
-    for blk in params["blocks"]:
+
+    def block(h, blk):
         x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
         q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
         k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
         v = heads(x @ blk["wv"])
         h = h + attn_fn(q, k, v).reshape(b, t, e) @ blk["wo"]
         x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-        h = h + jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"] + blk["b2"]
+        return h + jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"] \
+            + blk["b2"]
+
+    if remat:
+        block = jax.checkpoint(block)
+    for blk in params["blocks"]:
+        h = block(h, blk)
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])
     return (h @ params["head"]).astype(jnp.float32)
 
@@ -184,6 +203,7 @@ def lm_loss_sums(
     pos_offset: int | jax.Array = 0,
     positions: jax.Array | None = None,
     compute_dtype=None,
+    remat: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted next-token cross-entropy as ``(sum_ce, sum_weights)`` —
     the accumulator form, so the caller owns normalization: a single
@@ -193,7 +213,7 @@ def lm_loss_sums(
     copy task where only second-half positions are scored)."""
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
-        positions=positions, compute_dtype=compute_dtype,
+        positions=positions, compute_dtype=compute_dtype, remat=remat,
     )
     logprobs = jax.nn.log_softmax(logits)
     ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -212,13 +232,17 @@ def lm_correct_sums(
     pos_offset: int | jax.Array = 0,
     positions: jax.Array | None = None,
     compute_dtype=None,
+    remat: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted top-1 next-token hits as ``(sum_correct, sum_weights)``
     (accumulator form, same contract as :func:`lm_loss_sums` — and the
-    analogue of ``cnn.correct_count``)."""
+    analogue of ``cnn.correct_count``). ``remat`` is accepted for
+    signature symmetry with :func:`lm_loss_sums` (the trainer builds
+    both through one helper); it changes nothing in this never-
+    differentiated eval path."""
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
-        positions=positions, compute_dtype=compute_dtype,
+        positions=positions, compute_dtype=compute_dtype, remat=remat,
     )
     hits = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
     w = weights.astype(jnp.float32)

@@ -114,6 +114,14 @@ class SeqConfig:
     # Available for schemes full and ulysses; the ring keeps its own
     # blockwise streaming softmax.
     attn_impl: Literal["xla", "flash"] = "xla"
+    # Rematerialize each transformer block in the backward pass
+    # (jax.checkpoint): saved activation state per block drops from the
+    # attention residuals — the ring's O(T^2/P)-per-device sweep tiles —
+    # to the block input (O(T/P * d_model)), for ~1/3 extra FLOPs (one
+    # recomputed forward per block, the ring's ppermute chain included).
+    # The long-context memory lever (scaling-book recipe); measured by
+    # tests/test_lm.py and benchmarks/lm_longseq.py --remat.
+    remat: bool = False
     # Position-to-device layout for scheme="ring": "contiguous" = block i
     # on device i (device P-1 then computes on EVERY causal ring step —
     # the last-device hot spot); "zigzag" = the two-ended layout (device i
@@ -228,7 +236,7 @@ def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
         num, den = fn(
             params, tokens, targets, weights, config.spec, attn_fn=attn,
             positions=_shard_positions(config, t_local),
-            compute_dtype=config.dtype(),
+            compute_dtype=config.dtype(), remat=config.remat,
         )
         # Global sums over BOTH axes: sp shards hold different positions,
         # dp rows different sequences. (Eval data replicated over dp
@@ -278,6 +286,7 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
             num, den = transformer.lm_loss_sums(
                 p, tokens, targets, weights, config.spec, attn_fn=attn,
                 positions=pos, compute_dtype=config.dtype(),
+                remat=config.remat,
             )
             return num / lax.psum(den, AXES)
 
