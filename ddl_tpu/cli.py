@@ -176,6 +176,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "flash (Pallas flash-attention kernel on TPU — "
                          "O(T*block) score memory; pure-JAX reference "
                          "off-TPU); schemes full/ulysses only")
+    lm.add_argument("--tensor-parallel", type=int, default=1, metavar="TP",
+                    help="Megatron tensor parallelism: each block's "
+                         "QKV/W1 shard column-wise (H/TP heads, d_ff/TP "
+                         "hidden units per device), WO/W2 row-wise with "
+                         "one completing psum each; 3-D mesh "
+                         "[data-parallel, num-workers, TP], tp minor "
+                         "(its psums ride neighbouring ICI links)")
     lm.add_argument("--remat", action="store_true",
                     help="rematerialize each transformer block in the "
                          "backward pass (jax.checkpoint): per-block saved "
@@ -451,6 +458,7 @@ def _run_lm(args) -> int:
         seed=args.seed,
         num_workers=num_workers,
         data_parallel=args.data_parallel,
+        tensor_parallel=args.tensor_parallel,
         scheme=args.seq_scheme,
         compute_dtype=_resolve_dtype(args),
         target_accuracy=args.target_accuracy,
@@ -531,10 +539,12 @@ def main(argv: list[str] | None = None) -> int:
                     )
                 n_local = W // args.num_processes
             else:
-                # lm 2-D topologies need num_workers * data_parallel
-                # devices (data_parallel defaults to 1 elsewhere).
+                # lm 2-D/3-D topologies need num_workers * data_parallel
+                # * tensor_parallel devices (both default to 1 elsewhere).
                 n_local = max(
-                    (args.num_workers or 8) * args.data_parallel, 8
+                    (args.num_workers or 8) * args.data_parallel
+                    * args.tensor_parallel,
+                    8,
                 )
             jax.config.update("jax_num_cpu_devices", n_local)
     if args.multihost:

@@ -142,6 +142,7 @@ def apply_lm(
     positions: jax.Array | None = None,
     compute_dtype=None,
     remat: bool = False,
+    row_reduce=None,
 ) -> jax.Array:
     """Forward pass: int tokens ``[B, T]`` -> fp32 logits ``[B, T, vocab]``.
 
@@ -154,6 +155,17 @@ def apply_lm(
     ``attn_fn`` performs (possibly cross-shard) attention on post-RoPE
     ``[B, T, H, D]`` q/k/v and owns causal masking — the model applies no
     mask itself.
+
+    ``row_reduce`` is the tensor-parallel hook (Megatron sharding,
+    strategies/seq.py ``tensor_parallel``): when the caller hands this
+    function COLUMN-sharded ``wq/wk/wv/w1`` (+ their biases) and
+    ROW-sharded ``wo/w2`` slices, the attention output and MLP output
+    are partial sums over the tp shards — ``row_reduce`` (a
+    ``lax.psum`` over the tp axis) completes them. Everything else
+    needs NO code change: the head count is inferred from the local
+    ``wq`` width, so each shard attends its own head subset, and the
+    residual stream stays full-width (tp-invariant) on every device.
+    ``None`` (default) = no tensor parallelism.
 
     ``remat=True`` wraps each block in ``jax.checkpoint``: the backward
     pass recomputes the block — INCLUDING the cross-shard attention's
@@ -172,17 +184,22 @@ def apply_lm(
     b, t, e = h.shape
     if positions is None:
         positions = pos_offset + jnp.arange(t)
-    heads = lambda a: a.reshape(b, t, spec.num_heads, spec.head_dim)
+    # Local head count from the (possibly tp-column-sharded) wq width —
+    # the same code runs full-width and tensor-parallel.
+    heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
+    reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
 
     def block(h, blk):
         x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
         q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
         k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
         v = heads(x @ blk["wv"])
-        h = h + attn_fn(q, k, v).reshape(b, t, e) @ blk["wo"]
+        a = attn_fn(q, k, v)
+        h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
         x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-        return h + jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"] \
-            + blk["b2"]
+        return h + reduce_(
+            jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
+        ) + blk["b2"]
 
     if remat:
         block = jax.checkpoint(block)
@@ -204,6 +221,7 @@ def lm_loss_sums(
     positions: jax.Array | None = None,
     compute_dtype=None,
     remat: bool = False,
+    row_reduce=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted next-token cross-entropy as ``(sum_ce, sum_weights)`` —
     the accumulator form, so the caller owns normalization: a single
@@ -214,6 +232,7 @@ def lm_loss_sums(
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
         positions=positions, compute_dtype=compute_dtype, remat=remat,
+        row_reduce=row_reduce,
     )
     logprobs = jax.nn.log_softmax(logits)
     ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -233,6 +252,7 @@ def lm_correct_sums(
     positions: jax.Array | None = None,
     compute_dtype=None,
     remat: bool = False,
+    row_reduce=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted top-1 next-token hits as ``(sum_correct, sum_weights)``
     (accumulator form, same contract as :func:`lm_loss_sums` — and the
@@ -243,6 +263,7 @@ def lm_correct_sums(
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
         positions=positions, compute_dtype=compute_dtype, remat=remat,
+        row_reduce=row_reduce,
     )
     hits = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
     w = weights.astype(jnp.float32)

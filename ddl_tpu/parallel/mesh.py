@@ -10,6 +10,7 @@ handshake becomes a static layout computed at trace time).
 
 from __future__ import annotations
 
+import math
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -72,14 +73,47 @@ def make_mesh_2d(
     the minor (sp) axis is contiguous in it, so the sequence-parallel
     ring's ppermute hops ride neighbouring ICI links; dp collectives
     stride across rows (still ICI within a slice)."""
-    if num_dp < 1 or num_sp < 1:
-        raise ValueError(f"mesh axes must be >= 1, got {num_dp}x{num_sp}")
+    return _mesh_nd((num_dp, num_sp), axes, devices)
+
+
+# Tensor-parallel axis: Megatron-style column/row sharded block weights
+# (strategies/seq.py tensor_parallel).
+TP_AXIS = "tp"
+
+
+def make_mesh_3d(
+    num_dp: int,
+    num_sp: int,
+    num_tp: int,
+    *,
+    axes: tuple[str, str, str] = (DP_AXIS, SP_AXIS, TP_AXIS),
+    devices=None,
+) -> Mesh:
+    """A ``[num_dp, num_sp, num_tp]`` mesh over the first ``dp*sp*tp``
+    devices. The MINOR (tp) axis is contiguous in ``jax.devices()``
+    order — tensor-parallel psums are the highest-frequency collective
+    (two per block per step), so they get the neighbouring ICI links;
+    the sp ring's ppermute strides by ``num_tp`` (still short ICI hops
+    within a slice), and dp collectives stride widest."""
+    return _mesh_nd((num_dp, num_sp, num_tp), axes, devices)
+
+
+def _mesh_nd(shape: tuple[int, ...], axes: tuple[str, ...], devices) -> Mesh:
+    """Shared builder behind the 2-D/3-D mesh constructors: validates
+    sizes, slices the leading devices, and rejects topologies that leave
+    a process owning no devices (one copy of the check — the 2-D/3-D
+    twins diverging here would be invisible until a multi-process run)."""
+    if min(shape) < 1:
+        raise ValueError(
+            "mesh axes must be >= 1, got " + "x".join(map(str, shape))
+        )
     if devices is None:
         devices = jax.devices()
-    n = num_dp * num_sp
+    n = math.prod(shape)
     if n > len(devices):
         raise ValueError(
-            f"requested {num_dp}x{num_sp} devices, have {len(devices)}"
+            f"requested {'x'.join(map(str, shape))} devices, "
+            f"have {len(devices)}"
         )
     devices = list(devices)[:n]
     if jax.process_count() > 1:
@@ -91,7 +125,7 @@ def make_mesh_2d(
                 f"mesh over {n} devices owns no row on process(es) "
                 f"{sorted(missing)}; use a topology that spans every process"
             )
-    return Mesh(np.asarray(devices).reshape(num_dp, num_sp), axes)
+    return Mesh(np.asarray(devices).reshape(shape), axes)
 
 
 def extend_cpu_collective_timeouts(warn_s: int = 120, kill_s: int = 900) -> None:

@@ -64,7 +64,14 @@ from ..ops import adam_init, adam_update
 from ..ops.optimizers import AdamState
 from ..parallel import collectives as coll
 from ..parallel import multihost, ring
-from ..parallel.mesh import DP_AXIS, SP_AXIS, donation_for, make_mesh_2d
+from ..parallel.mesh import (
+    DP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    donation_for,
+    make_mesh_2d,
+    make_mesh_3d,
+)
 from .sync import ShardedAdam, _adam_flat
 from ..train.trainer import (
     check_preempt,
@@ -102,6 +109,17 @@ class SeqConfig:
     # Data-parallel degree (dp mesh axis): the global batch shards over
     # dp rows; total devices = data_parallel * num_workers.
     data_parallel: int = 1
+    # Tensor-parallel degree (tp mesh axis, Megatron sharding): each
+    # block's wq/wk/wv/w1 shard column-wise (each device owns H/tp heads
+    # and d_ff/tp hidden units) and wo/w2 row-wise; the attention and
+    # MLP outputs are completed by ONE psum over tp each — the only
+    # tensor-parallel collectives. The residual stream stays full-width
+    # everywhere, so tp composes orthogonally with sequence parallelism
+    # (the ring runs per local head subset) and data parallelism:
+    # total devices = data_parallel * num_workers * tensor_parallel on
+    # a 3-D [dp, sp, tp] mesh (tp minor — its psums are the highest-
+    # frequency collective, so they ride neighbouring ICI links).
+    tensor_parallel: int = 1
     scheme: Scheme = "ring"
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
     target_accuracy: float | None = None
@@ -151,6 +169,21 @@ class LMResult:
     preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
 
 
+def _vary_axes(config: SeqConfig) -> tuple[str, ...]:
+    """Every mesh axis the ring's q/k/v inputs vary over: dp/sp always
+    (data), plus tp when the block weights are tensor-sharded (q/k/v
+    then carry the tp-sharded head subset)."""
+    return AXES + (TP_AXIS,) if config.tensor_parallel > 1 else AXES
+
+
+def _row_reduce(config: SeqConfig):
+    """The tensor-parallel completion psum for apply_lm's row-sharded
+    matmul outputs (None when tp=1 — no collective inserted)."""
+    if config.tensor_parallel == 1:
+        return None
+    return lambda x: lax.psum(x, TP_AXIS)
+
+
 def _attn_for(config: SeqConfig, platform: str | None = None):
     """The per-shard attention closure for this config — always causal
     (decoder LM). ``full`` is the W=1 oracle; ring/ulysses derive their
@@ -183,7 +216,8 @@ def _attn_for(config: SeqConfig, platform: str | None = None):
     if config.scheme == "ring":
         return functools.partial(
             ring.ring_attention_shard, axis_name=SP_AXIS, axis_size=W,
-            causal=True, vary_axes=AXES, layout=config.seq_layout,
+            causal=True, vary_axes=_vary_axes(config),
+            layout=config.seq_layout,
         )
     if config.scheme == "ulysses":
         local = None
@@ -237,6 +271,7 @@ def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
             params, tokens, targets, weights, config.spec, attn_fn=attn,
             positions=_shard_positions(config, t_local),
             compute_dtype=config.dtype(), remat=config.remat,
+            row_reduce=_row_reduce(config),
         )
         # Global sums over BOTH axes: sp shards hold different positions,
         # dp rows different sequences. (Eval data replicated over dp
@@ -247,6 +282,27 @@ def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
         return lax.psum(_vary_all(num), AXES), lax.psum(_vary_all(den), AXES)
 
     return sums
+
+
+def _param_specs(config: SeqConfig):
+    """PartitionSpec tree for the LM params: a single replicated ``P()``
+    at tp=1 (put_tree's broadcast form — the pre-tp behavior, byte for
+    byte); the Megatron column/row assignment over TP_AXIS otherwise.
+    Column shards (wq/wk/wv/w1 + b1) put H/tp heads and d_ff/tp hidden
+    units on each device; row shards (wo/w2) consume them; everything
+    touching the full-width residual stream (LNs, embed, head, b2)
+    stays replicated."""
+    if config.tensor_parallel == 1:
+        return P()
+    col, row = P(None, TP_AXIS), P(TP_AXIS, None)
+    blk = {"ln1_g": P(), "ln1_b": P(), "wq": col, "wk": col, "wv": col,
+           "wo": row, "ln2_g": P(), "ln2_b": P(),
+           "w1": col, "b1": P(TP_AXIS), "w2": row, "b2": P()}
+    return {
+        "embed": P(),
+        "blocks": [dict(blk) for _ in range(config.spec.num_layers)],
+        "lnf_g": P(), "lnf_b": P(), "head": P(),
+    }
 
 
 class _FlatPlan:
@@ -342,13 +398,37 @@ class SeqTrainer:
     def __init__(self, config: SeqConfig, dataset: LMDataset):
         W = config.num_workers
         dp = config.data_parallel
+        tp = config.tensor_parallel
         if dataset.seq_len % max(W, 1):
             raise ValueError(
                 f"seq_len {dataset.seq_len} not divisible by {W} workers"
             )
-        if config.scheme == "ulysses" and config.spec.num_heads % max(W, 1):
+        if tp > 1:
+            if config.spec.num_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel needs num_heads "
+                    f"({config.spec.num_heads}) divisible by tp ({tp})"
+                )
+            if config.spec.d_ff % tp:
+                raise ValueError(
+                    f"tensor_parallel needs d_ff ({config.spec.d_ff}) "
+                    f"divisible by tp ({tp})"
+                )
+            if config.zero1:
+                raise ValueError(
+                    "zero1 composes with the dp x sp axes; with "
+                    "tensor_parallel > 1 the optimizer is already "
+                    "sharded tp-fold with the weights — unset one"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "tensor_parallel > 1 is single-controller for now "
+                    "(multi-process staging slices one sharded dim)"
+                )
+        local_heads = config.spec.num_heads // max(tp, 1)
+        if config.scheme == "ulysses" and local_heads % max(W, 1):
             raise ValueError(
-                f"ulysses needs num_heads ({config.spec.num_heads}) "
+                f"ulysses needs per-device num_heads ({local_heads}) "
                 f"divisible by num_workers ({W})"
             )
         # BOTH splits checked: JAX clamps out-of-range gather indices
@@ -383,9 +463,10 @@ class SeqTrainer:
                     f"seq_layout='zigzag' needs seq_len % (2 * num_workers)"
                     f" == 0, got {dataset.seq_len} % {2 * W}"
                 )
-        if dp < 1 or W < 1:
+        if dp < 1 or W < 1 or tp < 1:
             raise ValueError(
-                f"data_parallel ({dp}) and num_workers ({W}) must be >= 1"
+                f"data_parallel ({dp}), num_workers ({W}) and "
+                f"tensor_parallel ({tp}) must be >= 1"
             )
         if dp > 1 and jax.process_count() > 1:
             raise ValueError(
@@ -395,7 +476,18 @@ class SeqTrainer:
         _attn_for(config)  # fail fast: unknown scheme / full-with-sharding
         self.config = config
         self.dataset = dataset
-        self.mesh = make_mesh_2d(dp, W)
+        # tp=1 keeps the 2-D mesh (and therefore every pre-tp program
+        # byte for byte); tp>1 adds the minor tp axis.
+        self.mesh = (
+            make_mesh_3d(dp, W, tp) if tp > 1 else make_mesh_2d(dp, W)
+        )
+        self._pspecs = _param_specs(config)
+        # Optimizer placement mirrors the params (m/v are params-shaped);
+        # a single P() keeps put_tree's broadcast form at tp=1.
+        self._opt_specs = (
+            AdamState(step=P(), m=self._pspecs, v=self._pspecs)
+            if tp > 1 else P()
+        )
         # Kernel selection (flash vs reference twin) follows where the
         # program actually runs, not the default backend (round-4 advisor).
         self._platform = self.mesh.devices.flat[0].platform
@@ -412,7 +504,7 @@ class SeqTrainer:
         # deterministic init and the global replicated Array is assembled
         # from process-local data (no cross-host transfer).
         self.params = multihost.put_tree(
-            self.mesh, P(),
+            self.mesh, self._pspecs,
             transformer.init_lm_params(
                 jax.random.PRNGKey(config.seed), config.spec
             ),
@@ -429,7 +521,7 @@ class SeqTrainer:
             )
         else:
             self.opt_state = multihost.put_tree(
-                self.mesh, P(), adam_init(self.params)
+                self.mesh, self._opt_specs, adam_init(self.params)
             )
 
     # -- compiled programs -------------------------------------------------
@@ -461,8 +553,8 @@ class SeqTrainer:
             shard_step = jax.shard_map(
                 _step_body(self.config, self._platform),
                 mesh=self.mesh,
-                in_specs=(P(), P(), seq, seq, seq),
-                out_specs=(P(), P(), P()),
+                in_specs=(self._pspecs, self._opt_specs, seq, seq, seq),
+                out_specs=(self._pspecs, self._opt_specs, P()),
             )
 
         def run(params, opt_state, xs, ys, ws, first):
@@ -487,7 +579,7 @@ class SeqTrainer:
             _shard_sums(self.config, transformer.lm_correct_sums,
                         self._platform),
             mesh=self.mesh,
-            in_specs=(P(), P(None, SP_AXIS), P(None, SP_AXIS),
+            in_specs=(self._pspecs, P(None, SP_AXIS), P(None, SP_AXIS),
                       P(None, SP_AXIS)),
             out_specs=(P(), P()),
         )
@@ -548,7 +640,7 @@ class SeqTrainer:
         """Re-place a checkpoint-form optimizer state onto this trainer's
         mode: replicated AdamState, or flat chunks sharded over the mesh."""
         if not self.config.zero1:
-            return multihost.put_tree(self.mesh, P(), opt_tree)
+            return multihost.put_tree(self.mesh, self._opt_specs, opt_tree)
         n_dev = self.config.data_parallel * self.config.num_workers
         chunk = coll.chunk_size(self._plan.total, n_dev)
         refit = lambda tree: multihost.put(
@@ -607,7 +699,9 @@ class SeqTrainer:
             ckpt, resume, {"params": params, "opt": self._opt_like()}, log
         )
         if tree is not None:
-            params = multihost.put_tree(self.mesh, P(), tree["params"])
+            params = multihost.put_tree(
+                self.mesh, self._pspecs, tree["params"]
+            )
             opt_state = self._place_opt(tree["opt"])
         guarded(
             lambda: force(
