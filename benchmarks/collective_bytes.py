@@ -111,12 +111,16 @@ def audit_layout(policy: str, devices: int, tiny: bool = True) -> dict:
     }
 
 
-def audit_lm(mode: str, dp: int, sp: int) -> dict:
+def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     """Collective schedule of the LM train step (strategies/seq.py) on a
-    ``[dp, sp]`` mesh: ``replicated`` should show the grad all-reduce
-    (plus the ring's collective-permutes); ``zero1`` should replace it
-    with reduce-scatter + all-gather of ~total/(dp*sp)-element chunks —
-    the same evidence audit_layout gives for the CNN sharded step."""
+    ``[dp, sp(, tp)]`` mesh: ``replicated`` should show the grad
+    all-reduce (plus the ring's collective-permutes); ``zero1`` should
+    replace it with reduce-scatter + all-gather of ~total/(dp*sp)-element
+    chunks — the same evidence audit_layout gives for the CNN sharded
+    step. ``tp > 1`` should ADD exactly the Megatron schedule: per block
+    per direction, two activation-sized collectives over the tp axis
+    (the wo/w2 completion psums and their backward twins) — and nothing
+    param-sized (the tp-sharded weight grads never cross devices)."""
     import jax.numpy as jnp
 
     from ddl_tpu.data.lm import synthesize_copy
@@ -129,7 +133,7 @@ def audit_lm(mode: str, dp: int, sp: int) -> dict:
     tr = SeqTrainer(
         SeqConfig(num_workers=sp, data_parallel=dp, scheme="ring",
                   zero1=(mode == "zero1"), batch_size=nseq,
-                  spec=TINY_SPEC),
+                  tensor_parallel=tp, spec=TINY_SPEC),
         ds,
     )
     xs = tr._stage(ds.tokens, 1, nseq)
@@ -140,7 +144,8 @@ def audit_lm(mode: str, dp: int, sp: int) -> dict:
            .compile().as_text())
     ops = collective_ops(txt)
     return {
-        "mode": mode, "mesh": f"{dp}x{sp}",
+        "mode": mode,
+        "mesh": f"{dp}x{sp}" + (f"x{tp}" if tp > 1 else ""),
         "total_params": tr._plan.total,
         "collectives": ops,
         "reduce_bytes": sum(o["bytes"] for o in ops
@@ -175,6 +180,7 @@ def main() -> int:
         audit_lm("replicated", 1, args.devices),
         audit_lm("zero1", 1, args.devices),
         audit_lm("zero1", 2, half),
+        audit_lm("replicated", 1, half, tp=2),
     ]
     for r in lm_rows:
         print(f"[lm {r['mode']} {r['mesh']}] total={r['total_params']} "

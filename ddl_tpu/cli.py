@@ -433,16 +433,25 @@ def _run_lm(args) -> int:
 
     if args.data_parallel < 1:
         raise SystemExit(f"--data-parallel must be >= 1, got {args.data_parallel}")
+    if args.tensor_parallel < 1:
+        raise SystemExit(
+            f"--tensor-parallel must be >= 1, got {args.tensor_parallel}"
+        )
     if args.num_workers:
         num_workers = args.num_workers
     else:
-        # Default: all devices, split between the dp rows.
-        num_workers = max(1, _default_workers(args.variant) // args.data_parallel)
-    n_dev = num_workers * args.data_parallel
+        # Default: all devices, split between the dp rows and tp columns.
+        num_workers = max(
+            1,
+            _default_workers(args.variant)
+            // (args.data_parallel * args.tensor_parallel),
+        )
+    n_dev = num_workers * args.data_parallel * args.tensor_parallel
     if args.multihost:
         _ensure_devices(n_dev, allow_fallback=False,
-                        reason="use --num-workers * --data-parallel <= the "
-                               "world's global device count")
+                        reason="use --num-workers * --data-parallel * "
+                               "--tensor-parallel <= the world's global "
+                               "device count")
     else:
         _ensure_devices(n_dev, allow_fallback=args.platform is None,
                         reason="drop --platform to allow the "
