@@ -8,10 +8,17 @@ of the step at two batch sizes to attribute ``a``:
   fwd        forward pass only (no dropout)
   fwd_patches  forward with the cin=1 first conv as a patches matmul
              (cnn._patches_block) — vs `fwd` decides the MXU-lane question
+  fwd_tailmm forward with convs 3-4 (7x7/4x4 spatial) as patches matmuls
+             — vs `fwd` decides whether deep MXU contractions beat the
+             small-spatial conv kernels' fixed cost (round-4 verdict
+             task 2; off-TPU smoke measured tail 2.8x faster already)
+  fwd_allmm  every conv as a patches matmul
   fwd_drop   forward with dropout RNG (isolates threefry/bernoulli cost)
   grad       value_and_grad (fwd+bwd), no optimizer
   adam       Adam update alone on full-width grads (batch-independent)
   step       the full product train step (make_train_step)
+  step_tailmm  the product step with --conv-matmul tail — the
+             head-to-head that decides the recommended configuration
   span       a chunk_steps-long scan of the product step (make_epoch_chunk)
              at TWO span lengths — if per-step overhead falls with span
              length, the fixed term is per-DISPATCH (tunnel round-trip),
