@@ -35,6 +35,11 @@ import statistics
 import sys
 import time
 
+# Process-start stamp for main()'s wall-clock governor (BENCH_DEADLINE_S
+# counts from here, so the probe window spends the same budget the
+# driver's external timeout sees).
+_T0 = time.perf_counter()
+
 
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
 _PEAK_FLOPS = (
@@ -334,6 +339,17 @@ def main() -> None:
         os._exit(1)
     repeats = 3  # the tunnel is noisy; report best (capability) AND median
     sweep_k = 30  # span length of every sweep row (and the label source)
+    # Wall-clock governor: if the tunnel answered LATE in the probe
+    # window, the driver's ~30-min timeout is partly spent — shed the
+    # optional rows (large batches, long-span, tail, torch baseline)
+    # rather than get killed mid-run with no JSON emitted. The deadline
+    # counts from process start (the probe window is inside it).
+    deadline = _T0 + float(os.environ.get("BENCH_DEADLINE_S", 1500))
+    skipped: list[str] = []
+
+    def left() -> float:
+        return deadline - time.perf_counter()
+
     # Seed the host-data pool ONCE at the sweep's cap: growing it
     # per-batch (3k -> 6k -> ... -> 60k) would re-synthesize ~2x the
     # images across the ascending sweep (review finding r5).
@@ -346,6 +362,15 @@ def main() -> None:
     # batch 2000 — larger batches amortize it toward the chip's c-limit
     # (~430k img/s), the cheapest path to the 40% MFU target.
     for batch in (100, 200, 500, 1000, 2000, 4000, 8000):
+        # Only the FIRST row is unconditional (value must never be null
+        # once the backend answered); everything after sheds when the
+        # clock runs low — at the tunnel's documented ~5x variance even
+        # "core" rows can blow the driver's kill window (review r5).
+        if batch > 100 and left() < (180 if batch > 1000 else 120):
+            skipped.append(f"sweep_b{batch}")
+            print(f"[bench] SKIP batch {batch} (deadline: {left():.0f}s "
+                  "left)", file=sys.stderr)
+            continue
         vals = bench_single(batch, repeats, chunk_steps=sweep_k)
         sweep_best[batch] = round(max(vals), 1)
         sweep_median[batch] = round(statistics.median(vals), 1)
@@ -355,10 +380,15 @@ def main() -> None:
     best_batch = max(sweep_best, key=sweep_best.get)
     best = sweep_best[best_batch]
 
-    sync_vals = bench_sync_w1(best_batch, repeats)
-    print(f"[bench] sync W=1 batch {best_batch}: best {max(sync_vals):,.0f} "
-          f"median {statistics.median(sync_vals):,.0f} images/s",
-          file=sys.stderr)
+    sync_vals = None
+    if left() > 120:
+        sync_vals = bench_sync_w1(best_batch, repeats)
+        print(f"[bench] sync W=1 batch {best_batch}: "
+              f"best {max(sync_vals):,.0f} "
+              f"median {statistics.median(sync_vals):,.0f} images/s",
+              file=sys.stderr)
+    else:
+        skipped.append("sync_w1")
 
     # Long-span row: the SAME product program at span k=120 (one dispatch
     # per timing bracket). The sweep's k=30/rounds=3 brackets pay the
@@ -368,16 +398,20 @@ def main() -> None:
     # synthetic best case. The step-time decomposition behind this row:
     # benchmarks/step_anatomy.py.
     long_k = 120
-    long_vals = bench_single(best_batch, repeats, chunk_steps=long_k,
-                             rounds=1)
-    print(f"[bench] long span k={long_k} batch {best_batch}: "
-          f"best {max(long_vals):,.0f} "
-          f"median {statistics.median(long_vals):,.0f} images/s",
-          file=sys.stderr)
     headline_source = f"sweep_k{sweep_k}"
-    if max(long_vals) > best:
-        best = max(long_vals)
-        headline_source = f"long_span_k{long_k}"
+    long_vals = None
+    if left() > 120:
+        long_vals = bench_single(best_batch, repeats, chunk_steps=long_k,
+                                 rounds=1)
+        print(f"[bench] long span k={long_k} batch {best_batch}: "
+              f"best {max(long_vals):,.0f} "
+              f"median {statistics.median(long_vals):,.0f} images/s",
+              file=sys.stderr)
+        if max(long_vals) > best:
+            best = max(long_vals)
+            headline_source = f"long_span_k{long_k}"
+    else:
+        skipped.append(f"long_span_k{long_k}")
 
     # The kernel lever, measured INSIDE the driver's own bench run (the
     # round-4 fixed-term diagnosis attributes ~2ms/step to the
@@ -391,7 +425,13 @@ def main() -> None:
     # the extra compiles eat the driver's timeout budget.
     tail = {}
     if _conv_matmul_mode() != "tail":
-        for b in {best_batch, 100}:
+        # Ordered dedup: best_batch FIRST — it is the row that can move
+        # the headline, so it gets first claim on remaining time
+        # (set-iteration order would let the b=100 row starve it).
+        for b in dict.fromkeys((best_batch, 100)):
+            if left() < 150:
+                skipped.append(f"conv_matmul_tail_b{b}")
+                continue
             tvals = bench_single(b, repeats, chunk_steps=sweep_k,
                                  conv_matmul="tail")
             tail[b] = {"best": round(max(tvals), 1),
@@ -400,7 +440,7 @@ def main() -> None:
                   f"best {max(tvals):,.0f} "
                   f"median {statistics.median(tvals):,.0f} images/s",
                   file=sys.stderr)
-        if tail[best_batch]["best"] > best:
+        if best_batch in tail and tail[best_batch]["best"] > best:
             best = tail[best_batch]["best"]
             headline_source = f"conv_matmul_tail_b{best_batch}"
 
@@ -410,12 +450,17 @@ def main() -> None:
         round(100.0 * best * flops_per_image / peak, 2) if peak else None
     )
 
-    # Like-for-like comparison: both arms at batch 200.
-    try:
-        torch_ips = bench_torch_cpu(batch=200)
-        vs = round(sweep_best[200] / torch_ips, 2)
-    except Exception:
-        vs = None  # baseline unavailable — never fabricate 1.0x parity
+    # Like-for-like comparison: both arms at batch 200 (needs the TPU
+    # arm's batch-200 row, which a starved run may have shed).
+    vs = None  # baseline unavailable — never fabricate 1.0x parity
+    if left() > 60 and 200 in sweep_best:
+        try:
+            torch_ips = bench_torch_cpu(batch=200)
+            vs = round(sweep_best[200] / torch_ips, 2)
+        except Exception:
+            pass
+    else:
+        skipped.append("torch_baseline")
     print(json.dumps({
         "metric": "mnist_sync_images_per_sec_per_chip",
         "value": round(best, 1),
@@ -425,17 +470,18 @@ def main() -> None:
         "batch": best_batch,
         "sweep": sweep_best,
         "sweep_median": sweep_median,
-        "sync_w1": {
+        "sync_w1": None if sync_vals is None else {
             "best": round(max(sync_vals), 1),
             "median": round(statistics.median(sync_vals), 1),
             "batch": best_batch,
         },
-        "long_span": {
+        "long_span": None if long_vals is None else {
             "best": round(max(long_vals), 1),
             "median": round(statistics.median(long_vals), 1),
             "batch": best_batch,
             "chunk_steps": long_k,
         },
+        "skipped_for_deadline": skipped,
         "headline_source": headline_source,
         "conv_matmul": _conv_matmul_mode(),
         "conv_matmul_tail": tail,
