@@ -41,6 +41,22 @@ import time
 _T0 = time.perf_counter()
 
 
+def make_deadline(env_var: str, default_s: float, t0: float | None = None):
+    """Shared wall-clock governor for the bench tools: returns ``left()``
+    seconds remaining on a deadline of ``t0 + $env_var`` (default
+    ``default_s``). ``t0`` MUST be the tool's process-start stamp
+    (default: this module's import time — correct for ``bench.py``
+    itself; other tools pass their own module-import stamp), so time
+    spent probing a dead tunnel draws from the same budget the driver's
+    external kill timer sees — a late-answering tunnel must shed rows,
+    not run past the kill into an artifact-less rc=124."""
+    import os
+
+    start = _T0 if t0 is None else t0
+    dl = start + float(os.environ.get(env_var, default_s))
+    return lambda: dl - time.perf_counter()
+
+
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
 _PEAK_FLOPS = (
     ("v5 lite", 197e12),
@@ -342,13 +358,9 @@ def main() -> None:
     # Wall-clock governor: if the tunnel answered LATE in the probe
     # window, the driver's ~30-min timeout is partly spent — shed the
     # optional rows (large batches, long-span, tail, torch baseline)
-    # rather than get killed mid-run with no JSON emitted. The deadline
-    # counts from process start (the probe window is inside it).
-    deadline = _T0 + float(os.environ.get("BENCH_DEADLINE_S", 1500))
+    # rather than get killed mid-run with no JSON emitted.
+    left = make_deadline("BENCH_DEADLINE_S", 1500)
     skipped: list[str] = []
-
-    def left() -> float:
-        return deadline - time.perf_counter()
 
     # Seed the host-data pool ONCE at the sweep's cap: growing it
     # per-batch (3k -> 6k -> ... -> 60k) would re-synthesize ~2x the

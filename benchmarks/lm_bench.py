@@ -29,6 +29,11 @@ import os
 import sys
 import time
 
+# Process-start stamp for the wall-clock governor (bench.make_deadline):
+# probe-window time must draw from the same budget an external kill
+# timer sees.
+_T0 = time.perf_counter()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
@@ -89,14 +94,34 @@ def main() -> None:
                   d_ff=args.d_ff)
     platform = jax.devices()[0].platform
     peak = bench._chip_peak_flops()
+    # Wall-clock governor (bench.make_deadline, stamped at process
+    # start so probe time spends the same budget an external kill timer
+    # sees): rows ascend in cost, so when the budget runs low the
+    # remaining (longer-seq) rows are shed WHOLE — no dataset is
+    # synthesized, no impl-less stub lands in the results — and
+    # whatever was measured still emits as a parseable artifact. The
+    # first row is unconditional (never an empty artifact).
+    left = bench.make_deadline("LM_BENCH_DEADLINE_S", 2400, t0=_T0)
+    skipped = []
+    measured = 0
     rows = {}
     for T in args.seq_lens:
+        if measured and left() < 240:
+            skipped.append(f"T{T}")
+            print(f"[lm_bench] SKIP T={T} entirely (deadline)",
+                  file=sys.stderr)
+            continue
         B = max(1, args.tokens_per_batch // T)
         k = args.span
         ds = synthesize_copy(num_train=B * k, num_test=B, seq_len=T,
                              vocab=args.vocab, seed=0)
         rows[T] = {"seqs_per_batch": B}
         for impl in args.attn_impls:
+            if measured and left() < 240:
+                skipped.append(f"T{T}_{impl}")
+                print(f"[lm_bench] SKIP T={T} {impl} (deadline)",
+                      file=sys.stderr)
+                continue
             cfg = SeqConfig(num_workers=1, scheme="full",
                             compute_dtype="bfloat16", batch_size=B,
                             attn_impl=impl, spec=spec)
@@ -126,6 +151,7 @@ def main() -> None:
                 "median_tokens_per_s": round(med, 1), "mfu_pct": mfu,
                 "compile_s": round(compile_s, 1),
             }
+            measured += 1
             print(f"[lm_bench] T={T} B={B} {impl}: best {best:,.0f} tok/s "
                   f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
 
@@ -138,6 +164,7 @@ def main() -> None:
                  "params": spec.num_params()},
         "span_steps": args.span,
         "results": rows,
+        "skipped_for_deadline": skipped,
     }
     line = json.dumps(out)
     print(line)
