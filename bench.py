@@ -41,19 +41,18 @@ import time
 _T0 = time.perf_counter()
 
 
-def make_deadline(env_var: str, default_s: float, t0: float | None = None):
+def make_deadline(env_var: str, default_s: float, t0: float):
     """Shared wall-clock governor for the bench tools: returns ``left()``
     seconds remaining on a deadline of ``t0 + $env_var`` (default
-    ``default_s``). ``t0`` MUST be the tool's process-start stamp
-    (default: this module's import time — correct for ``bench.py``
-    itself; other tools pass their own module-import stamp), so time
-    spent probing a dead tunnel draws from the same budget the driver's
-    external kill timer sees — a late-answering tunnel must shed rows,
-    not run past the kill into an artifact-less rc=124."""
+    ``default_s``). ``t0`` is REQUIRED and must be the calling tool's
+    own process-start stamp (its module-import time — bench.py passes
+    its ``_T0``): tools import this module only after their probe
+    window, so a defaulted stamp would grant a budget up to a whole
+    probe window longer than the driver's external kill timer sees and
+    re-create the artifact-less rc=124 this helper exists to prevent."""
     import os
 
-    start = _T0 if t0 is None else t0
-    dl = start + float(os.environ.get(env_var, default_s))
+    dl = t0 + float(os.environ.get(env_var, default_s))
     return lambda: dl - time.perf_counter()
 
 
@@ -359,7 +358,7 @@ def main() -> None:
     # window, the driver's ~30-min timeout is partly spent — shed the
     # optional rows (large batches, long-span, tail, torch baseline)
     # rather than get killed mid-run with no JSON emitted.
-    left = make_deadline("BENCH_DEADLINE_S", 1500)
+    left = make_deadline("BENCH_DEADLINE_S", 1500, _T0)
     skipped: list[str] = []
 
     # Seed the host-data pool ONCE at the sweep's cap: growing it

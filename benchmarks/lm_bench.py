@@ -115,38 +115,50 @@ def main() -> None:
         k = args.span
         ds = synthesize_copy(num_train=B * k, num_test=B, seq_len=T,
                              vocab=args.vocab, seed=0)
-        rows[T] = {"seqs_per_batch": B}
+        row = {"seqs_per_batch": B}
         for impl in args.attn_impls:
             if measured and left() < 240:
                 skipped.append(f"T{T}_{impl}")
                 print(f"[lm_bench] SKIP T={T} {impl} (deadline)",
                       file=sys.stderr)
                 continue
-            cfg = SeqConfig(num_workers=1, scheme="full",
-                            compute_dtype="bfloat16", batch_size=B,
-                            attn_impl=impl, spec=spec)
-            tr = SeqTrainer(cfg, ds)
-            xs = tr._stage(ds.tokens, k, B)
-            ys = tr._stage(ds.targets, k, B)
-            ws = tr._stage(ds.weights, k, B)
-            params, opt = tr.params, tr.opt_state
-            force((xs, ys, ws, params, opt), all_leaves=True)
-            t0 = time.perf_counter()
-            fn = (tr._span_fn(k)
-                  .lower(params, opt, xs, ys, ws, jnp.int32(0)).compile())
-            compile_s = time.perf_counter() - t0
-            params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
-            force((params, opt, loss))  # warmup barrier
-            tps = []
-            for _ in range(args.repeats):
+            # One impl crashing (e.g. a Pallas lowering failure on the
+            # flash branch's FIRST hardware run) must not discard the
+            # rows already measured: record the error and keep going.
+            try:
+                cfg = SeqConfig(num_workers=1, scheme="full",
+                                compute_dtype="bfloat16", batch_size=B,
+                                attn_impl=impl, spec=spec)
+                tr = SeqTrainer(cfg, ds)
+                xs = tr._stage(ds.tokens, k, B)
+                ys = tr._stage(ds.targets, k, B)
+                ws = tr._stage(ds.weights, k, B)
+                params, opt = tr.params, tr.opt_state
+                force((xs, ys, ws, params, opt), all_leaves=True)
                 t0 = time.perf_counter()
-                params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))
-                force((params, opt, loss))  # true barrier: host fetch
-                tps.append(k * B * T / (time.perf_counter() - t0))
+                fn = (tr._span_fn(k)
+                      .lower(params, opt, xs, ys, ws, jnp.int32(0))
+                      .compile())
+                compile_s = time.perf_counter() - t0
+                params, opt, loss = fn(params, opt, xs, ys, ws,
+                                       jnp.int32(0))
+                force((params, opt, loss))  # warmup barrier
+                tps = []
+                for _ in range(args.repeats):
+                    t0 = time.perf_counter()
+                    params, opt, loss = fn(params, opt, xs, ys, ws,
+                                           jnp.int32(0))
+                    force((params, opt, loss))  # true barrier: host fetch
+                    tps.append(k * B * T / (time.perf_counter() - t0))
+            except Exception as e:  # noqa: BLE001 — record, don't discard
+                row[impl] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                print(f"[lm_bench] T={T} {impl} FAILED: {e}",
+                      file=sys.stderr)
+                continue
             best, med = float(max(tps)), float(np.median(tps))
             mfu = (round(100.0 * best * flops_per_token(spec, T) / peak, 2)
                    if peak else None)
-            rows[T][impl] = {
+            row[impl] = {
                 "best_tokens_per_s": round(best, 1),
                 "median_tokens_per_s": round(med, 1), "mfu_pct": mfu,
                 "compile_s": round(compile_s, 1),
@@ -154,6 +166,10 @@ def main() -> None:
             measured += 1
             print(f"[lm_bench] T={T} B={B} {impl}: best {best:,.0f} tok/s "
                   f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
+        if len(row) > 1:  # at least one impl entry — no impl-less stubs
+            rows[T] = row
+        else:
+            skipped.append(f"T{T}")
 
     out = {
         "metric": "lm_train_tokens_per_sec",

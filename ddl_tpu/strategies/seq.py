@@ -195,6 +195,11 @@ def _attn_for(config: SeqConfig, platform: str | None = None):
     backend (round-4 advisor — a trainer jitting onto a non-default
     backend would otherwise pick the wrong kernel)."""
     W = config.num_workers
+    if config.attn_impl not in ("xla", "flash"):
+        # Literal annotations don't validate at runtime — an unknown
+        # kernel name must not silently run the einsum path (found by a
+        # round-5 bench-harness simulation doing exactly that).
+        raise ValueError(f"unknown attn_impl {config.attn_impl!r}")
     flash = config.attn_impl == "flash"
     if flash and config.scheme == "ring":
         raise ValueError(
