@@ -38,6 +38,10 @@ def bench_strategy(variant: str, workers: int, steps: int, batch: int) -> float:
 
     if variant == "lm_ring":
         return bench_lm_ring(workers, steps, batch)
+    if variant == "lm_ring_tp2":
+        # sp x tp on the SAME device count as the lm_ring row (skipped
+        # below at W=1 — tp=2 needs at least 2 devices).
+        return bench_lm_ring(workers, steps, batch, tp=2)
 
     mesh = make_mesh(workers)
     x_np, y_np = synthesize(batch, seed=0)
@@ -113,13 +117,17 @@ def bench_strategy(variant: str, workers: int, steps: int, batch: int) -> float:
     return steps * batch / dt
 
 
-def bench_lm_ring(workers: int, steps: int, batch: int) -> float:
+def bench_lm_ring(workers: int, steps: int, batch: int,
+                  tp: int = 1) -> float:
     """Sequence-parallel LM retention row: tokens/sec through the product
     ``SeqTrainer`` span program (ring attention over sp), sequence length
     fixed at 256 so the W sweep varies only the SHARDING — on the 1-core
     proxy ideal is constant tokens/s and the retained fraction is the
     ring/psum program overhead (same reading as the CNN rows). ``batch``
-    is interpreted as a token budget per step (sequences = batch // 256)."""
+    is interpreted as a token budget per step (sequences = batch // 256).
+    ``tp > 1`` splits the same ``workers`` devices into a [1, W/tp, tp]
+    mesh — the sp×tp composition vs pure sp at EQUAL device count, i.e.
+    the algorithmic cost of the Megatron completion psums."""
     import jax
     import jax.numpy as jnp
 
@@ -135,8 +143,8 @@ def bench_lm_ring(workers: int, steps: int, batch: int) -> float:
     ds = synthesize_copy(num_train=nseq * k, num_test=nseq, seq_len=T,
                          vocab=64, seed=0)
     tr = SeqTrainer(
-        SeqConfig(num_workers=workers, scheme="ring", batch_size=nseq,
-                  spec=spec),
+        SeqConfig(num_workers=workers // tp, scheme="ring", batch_size=nseq,
+                  tensor_parallel=tp, spec=spec),
         ds,
     )
     xs = tr._stage(ds.tokens, k, nseq)
@@ -174,8 +182,8 @@ def main() -> int:
     ap.add_argument("--variants", default=None,
                     help="comma-separated subset of "
                          "sync_dp,sharded_flat,sharded_greedy,async,"
-                         "async_replicated,lm_ring (default: all but "
-                         "async_replicated)")
+                         "async_replicated,lm_ring,lm_ring_tp2 "
+                         "(default: all but async_replicated)")
     args = ap.parse_args()
 
     import jax
@@ -189,10 +197,10 @@ def main() -> int:
     medians: dict[str, dict[int, float]] = {}
     widths = [w for w in (1, 2, 4, 8) if w <= args.devices]
     known = ("sync_dp", "sharded_flat", "sharded_greedy", "async",
-             "async_replicated", "lm_ring")
+             "async_replicated", "lm_ring", "lm_ring_tp2")
     variants = (
         args.variants.split(",")
-        if args.variants else list(known[:4]) + ["lm_ring"]
+        if args.variants else list(known[:4]) + ["lm_ring", "lm_ring_tp2"]
     )
     bad = [v for v in variants if v not in known]
     if bad:
@@ -214,7 +222,7 @@ def main() -> int:
             medians.setdefault(variant, {})[w] = round(
                 statistics.median(vals), 1
             )
-            unit = "tok/s" if variant == "lm_ring" else "img/s"
+            unit = "tok/s" if variant.startswith("lm_ring") else "img/s"
             print(f"{variant:15s} W={w}: best {ips:10.1f} {unit} "
                   f"median {statistics.median(vals):10.1f} "
                   f"(raw {[round(v) for v in vals]})", flush=True)
@@ -229,7 +237,11 @@ def main() -> int:
     # a subset run without the matching W=1 baseline reports raw
     # throughput only (the loop skips it).
     for variant, per_w in results.items():
-        b = per_w.get(1) if variant == "lm_ring" else base
+        # lm rows retain vs the LM's own W=1 (tokens/s units); the tp
+        # composition row shares lm_ring's baseline — same model, same
+        # token budget, equal device counts per column.
+        b = (results.get("lm_ring", {}).get(1)
+             if variant.startswith("lm_ring") else base)
         if b is None:
             continue
         for w, ips in per_w.items():
