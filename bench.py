@@ -166,10 +166,22 @@ def _conv_matmul_mode() -> str:
     """Conv lowering for the benched step: ``BENCH_CONV_MATMUL`` env
     (none/first/tail/all — models/cnn.py CONV_MATMUL_MODES). Default
     "none" = the product default; tpu_suite.sh sweeps the alternatives
-    so the headline always reflects a MEASURED winner, never a guess."""
+    so the headline always reflects a MEASURED winner, never a guess.
+    Validated against CONV_MATMUL_MODES here — main() calls this BEFORE
+    ``wait_backend`` so a typo dies as a clean one-liner instead of a
+    KeyError deep in jit tracing after the probe window is spent
+    (round-5 advice #1)."""
     import os
 
-    return os.environ.get("BENCH_CONV_MATMUL", "none")
+    from ddl_tpu.models.cnn import CONV_MATMUL_MODES
+
+    mode = os.environ.get("BENCH_CONV_MATMUL", "none")
+    if mode not in CONV_MATMUL_MODES:
+        raise SystemExit(
+            f"BENCH_CONV_MATMUL={mode!r} is not a conv lowering mode; "
+            f"choose from {sorted(CONV_MATMUL_MODES)}"
+        )
+    return mode
 
 
 def bench_single(batch: int, repeats: int, *, chunk_steps: int = 30,
@@ -305,19 +317,35 @@ def cached_last_measured() -> dict | None:
         mtime = os.path.getmtime(path)
     except (OSError, ValueError):
         return None
-    return {
+    if rec.get("value") is None:
+        # A dead-tunnel round's own null artifact on disk is NOT a
+        # hardware measurement — relaying it as "CACHED from the last
+        # successful hardware run" would launder a failure into a
+        # number (round-5 advice #2).
+        return None
+    recorded = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime))
+    out = {
         "note": "CACHED from the last successful hardware run — NOT "
                 "measured this round (tunnel unreachable)",
-        "recorded_utc": time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
-        ),
+        "recorded_utc": recorded,
         "source": "benchmarks/results/bench_tpu.json",
         "value": rec.get("value"),
         "unit": rec.get("unit"),
         "batch": rec.get("batch"),
         "mfu_pct": rec.get("mfu_pct"),
-        "vs_baseline": rec.get("vs_baseline"),
     }
+    if rec.get("vs_baseline") is not None:
+        # Derived ratio: field-local provenance so a driver parsing
+        # .vs_baseline.value can never mistake the stale comparison for
+        # a current one (round-5 verdict weak #6 / next-round #7) —
+        # both arms (TPU and torch-CPU) date from the cached run.
+        out["vs_baseline"] = {
+            "value": rec.get("vs_baseline"),
+            "measured_utc": recorded,
+            "note": "stale ratio: both arms from the cached run above, "
+                    "NOT a comparison made this round",
+        }
+    return out
 
 
 def main() -> None:
@@ -325,6 +353,7 @@ def main() -> None:
 
     from ddl_tpu.parallel.mesh import wait_backend
 
+    _conv_matmul_mode()  # typo in BENCH_CONV_MATMUL dies BEFORE the probe
     # Bounded retry window (default 20 min, probe every 3 min): the shared
     # TPU tunnel drops for minutes-to-hours at a time, and a single-probe
     # exit nulled round 3's driver bench (BENCH_r03.json rc=1). Probes run

@@ -111,6 +111,18 @@ def audit_layout(policy: str, devices: int, tiny: bool = True) -> dict:
     }
 
 
+def _opt_bytes_per_device(opt_state) -> int:
+    """Per-device resident bytes of a (possibly sharded) optimizer-state
+    pytree — the measured side of the ZeRO-1 memory law. Every leaf's
+    device-0 addressable shard is counted; shardings here are uniform."""
+    import jax
+
+    return sum(
+        l.addressable_shards[0].data.size * l.dtype.itemsize
+        for l in jax.tree.leaves(opt_state)
+    )
+
+
 def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     """Collective schedule of the LM train step (strategies/seq.py) on a
     ``[dp, sp(, tp)]`` mesh: ``replicated`` should show the grad
@@ -120,7 +132,15 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     step. ``tp > 1`` should ADD exactly the Megatron schedule: per block
     per direction, two activation-sized collectives over the tp axis
     (the wo/w2 completion psums and their backward twins) — and nothing
-    param-sized (the tp-sharded weight grads never cross devices)."""
+    param-sized (the tp-sharded weight grads never cross devices).
+    ``zero1`` x ``tp > 1`` is the HYBRID schedule: reduce-scatter +
+    all-gather of the tp-REPLICATED subtree's ~rep_total/(dp*sp)-element
+    chunks (``rep_total`` in the row), per-tp-shard weight-grad
+    all-reduces over (dp, sp), and the Megatron activation psums.
+
+    Every row also carries ``opt_state_bytes_per_device`` — the measured
+    optimizer-state residency behind the memory-law table
+    (BASELINE.md)."""
     import jax.numpy as jnp
 
     from ddl_tpu.data.lm import synthesize_copy
@@ -143,14 +163,18 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
            .lower(tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0))
            .compile().as_text())
     ops = collective_ops(txt)
-    return {
+    row = {
         "mode": mode,
         "mesh": f"{dp}x{sp}" + (f"x{tp}" if tp > 1 else ""),
         "total_params": tr._plan.total,
+        "opt_state_bytes_per_device": _opt_bytes_per_device(tr.opt_state),
         "collectives": ops,
         "reduce_bytes": sum(o["bytes"] for o in ops
                             if o["op"] in ("all-reduce", "reduce-scatter")),
     }
+    if tr._hplan is not None:
+        row["rep_total"] = tr._hplan.rep_total
+    return row
 
 
 def main() -> int:
@@ -182,14 +206,55 @@ def main() -> int:
         audit_lm("zero1", 2, half),
         audit_lm("replicated", 1, half, tp=2),
     ]
+    if args.devices >= 8:
+        # The zero1 x tp tentpole pair on the SAME 2x2x2 cube: identical
+        # mesh, identical model — the only delta is the hybrid sharded
+        # optimizer, so the bytes/residency comparison is like-for-like.
+        lm_rows.append(audit_lm("replicated", 2, 2, tp=2))
+        lm_rows.append(audit_lm("zero1", 2, 2, tp=2))
     for r in lm_rows:
         print(f"[lm {r['mode']} {r['mesh']}] total={r['total_params']} "
-              f"reduce_bytes={r['reduce_bytes']}", file=sys.stderr)
+              f"reduce_bytes={r['reduce_bytes']} "
+              f"opt_bytes/dev={r['opt_state_bytes_per_device']}",
+              file=sys.stderr)
         for o in r["collectives"]:
             print(f"    {o['op']:<18} {o['dtype']}{o['shape']} "
                   f"= {o['bytes']} B", file=sys.stderr)
+    # Memory law: per-device optimizer-state bytes, replicated-Adam tp
+    # vs the hybrid zero1 x tp on the same cube. The tp-REPLICATED
+    # subtree's m/v drop by exactly (dp*sp); the tp-sharded leaves'
+    # state is identical in both modes, so the overall ratio interpolates
+    # toward (dp*sp) as embed/head dominate the parameter budget (they
+    # do at production vocab/d_model; TINY_SPEC understates it).
+    memory_law = None
+    if args.devices >= 8:
+        rep_row = next(r for r in lm_rows
+                       if r["mode"] == "replicated" and r["mesh"] == "2x2x2")
+        z1_row = next(r for r in lm_rows
+                      if r["mode"] == "zero1" and r["mesh"] == "2x2x2")
+        rep_total = z1_row["rep_total"]
+        chunk = -(-rep_total // 4)
+        memory_law = {
+            "mesh": "2x2x2 (dp x sp x tp)",
+            "replicated_tp_opt_bytes_per_device":
+                rep_row["opt_state_bytes_per_device"],
+            "zero1_tp_opt_bytes_per_device":
+                z1_row["opt_state_bytes_per_device"],
+            "rep_subtree_elems_per_device": {
+                "replicated": rep_total, "zero1": chunk,
+                "factor": round(rep_total / chunk, 2),
+            },
+        }
+        print(f"[memory law 2x2x2] replicated-tp "
+              f"{memory_law['replicated_tp_opt_bytes_per_device']} B/dev "
+              f"vs zero1-tp "
+              f"{memory_law['zero1_tp_opt_bytes_per_device']} B/dev; "
+              f"rep-subtree m/v elems {rep_total} -> {chunk} "
+              f"({memory_law['rep_subtree_elems_per_device']['factor']}x)",
+              file=sys.stderr)
     result = {"metric": "sharded_step_collective_bytes",
-              "devices": args.devices, "layouts": rows, "lm": lm_rows}
+              "devices": args.devices, "layouts": rows, "lm": lm_rows,
+              "memory_law": memory_law}
     print(json.dumps(result))
     if args.json_path:
         with open(args.json_path, "w") as f:

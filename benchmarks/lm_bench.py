@@ -103,6 +103,7 @@ def main() -> None:
     # first row is unconditional (never an empty artifact).
     left = bench.make_deadline("LM_BENCH_DEADLINE_S", 2400, t0=_T0)
     skipped = []
+    failed = {}
     measured = 0
     rows = {}
     for T in args.seq_lens:
@@ -166,8 +167,15 @@ def main() -> None:
             measured += 1
             print(f"[lm_bench] T={T} B={B} {impl}: best {best:,.0f} tok/s "
                   f"(median {med:,.0f}, mfu {mfu}%)", file=sys.stderr)
-        if len(row) > 1:  # at least one impl entry — no impl-less stubs
-            rows[T] = row
+        impls = {k: v for k, v in row.items() if k != "seqs_per_batch"}
+        if any("error" not in v for v in impls.values()):
+            rows[T] = row  # at least one real measurement (errors ride
+            # along field-local so a partial row keeps its crash record)
+        elif impls:
+            # Every impl raised: that row is a CRASH, not a measurement
+            # and not deadline shedding — its own ledger so artifact
+            # consumers can tell the three apart (round-5 advice #3).
+            failed[str(T)] = row
         else:
             skipped.append(f"T{T}")
 
@@ -181,6 +189,7 @@ def main() -> None:
         "span_steps": args.span,
         "results": rows,
         "skipped_for_deadline": skipped,
+        "failed": failed,
     }
     line = json.dumps(out)
     print(line)

@@ -182,7 +182,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "hidden units per device), WO/W2 row-wise with "
                          "one completing psum each; 3-D mesh "
                          "[data-parallel, num-workers, TP], tp minor "
-                         "(its psums ride neighbouring ICI links)")
+                         "(its psums ride neighbouring ICI links); "
+                         "composes with --zero1 (hybrid sharded "
+                         "optimizer) and with --multihost worlds")
     lm.add_argument("--remat", action="store_true",
                     help="rematerialize each transformer block in the "
                          "backward pass (jax.checkpoint): per-block saved "
@@ -206,8 +208,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "reduce-scatter grads, Adam on each device's "
                          "flat chunk (m/v owner-resident — optimizer "
                          "memory /(DP*num-workers)), all_gather params; "
-                         "composes with any --seq-scheme and "
-                         "--data-parallel")
+                         "composes with any --seq-scheme, "
+                         "--data-parallel, AND --tensor-parallel (the "
+                         "hybrid sharded optimizer: tp-sharded weights "
+                         "keep tp-local Adam state, the tp-replicated "
+                         "subtree — embed/head/LayerNorms — shards its "
+                         "Adam state over dp x sp)")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -537,16 +543,20 @@ def main(argv: list[str] | None = None) -> int:
         if args.platform == "cpu":
             if args.multihost and args.num_processes:
                 # Multi-process CPU world: the GLOBAL device count must be
-                # num_workers, spread evenly over the processes — a blanket
-                # 8 per process would put the whole mesh on process 0 and
-                # leave the others owning no rows (make_mesh rejects that).
-                W = args.num_workers or args.num_processes
-                if W % args.num_processes:
+                # the full mesh (num_workers, times dp and tp for the lm
+                # 3-D topologies), spread evenly over the processes — a
+                # blanket 8 per process would put the whole mesh on
+                # process 0 and leave the others owning no rows
+                # (make_mesh rejects that).
+                total = ((args.num_workers or args.num_processes)
+                         * args.data_parallel * args.tensor_parallel)
+                if total % args.num_processes:
                     raise SystemExit(
-                        f"--num-workers {W} is not divisible by "
-                        f"--num-processes {args.num_processes}"
+                        f"total devices {total} (num-workers x "
+                        f"data-parallel x tensor-parallel) is not "
+                        f"divisible by --num-processes {args.num_processes}"
                     )
-                n_local = W // args.num_processes
+                n_local = total // args.num_processes
             else:
                 # lm 2-D/3-D topologies need num_workers * data_parallel
                 # * tensor_parallel devices (both default to 1 elsewhere).
@@ -555,7 +565,9 @@ def main(argv: list[str] | None = None) -> int:
                     * args.tensor_parallel,
                     8,
                 )
-            jax.config.update("jax_num_cpu_devices", n_local)
+            from .parallel.mesh import set_cpu_device_count
+
+            set_cpu_device_count(n_local)
     if args.multihost:
         # Before any backend use: joining the world after the local backend
         # initializes would freeze a single-process device view.

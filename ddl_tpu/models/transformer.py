@@ -143,6 +143,7 @@ def apply_lm(
     compute_dtype=None,
     remat: bool = False,
     row_reduce=None,
+    col_promote=None,
 ) -> jax.Array:
     """Forward pass: int tokens ``[B, T]`` -> fp32 logits ``[B, T, vocab]``.
 
@@ -160,12 +161,20 @@ def apply_lm(
     strategies/seq.py ``tensor_parallel``): when the caller hands this
     function COLUMN-sharded ``wq/wk/wv/w1`` (+ their biases) and
     ROW-sharded ``wo/w2`` slices, the attention output and MLP output
-    are partial sums over the tp shards — ``row_reduce`` (a
-    ``lax.psum`` over the tp axis) completes them. Everything else
-    needs NO code change: the head count is inferred from the local
-    ``wq`` width, so each shard attends its own head subset, and the
-    residual stream stays full-width (tp-invariant) on every device.
-    ``None`` (default) = no tensor parallelism.
+    are partial sums over the tp shards — ``row_reduce`` (Megatron's
+    ``g``: ``collectives.tp_allreduce``, all-reduce forward / identity
+    backward) completes them. ``col_promote`` is its CONJUGATE
+    (Megatron's ``f``: ``collectives.tp_promote``, identity forward /
+    all-reduce backward), applied where the tp-replicated residual
+    stream enters the column-sharded matmuls — each tp member's branch
+    produces only a PARTIAL input cotangent, and ``f`` completes the
+    sum so LayerNorm params, earlier blocks and the embedding see full
+    gradients even when the surrounding ``shard_map`` computes local
+    (unreduced) grads. Everything else needs NO code change: the head
+    count is inferred from the local ``wq`` width, so each shard
+    attends its own head subset, and the residual stream stays
+    full-width (tp-invariant) on every device. ``None`` (default) =
+    no tensor parallelism.
 
     ``remat=True`` wraps each block in ``jax.checkpoint``: the backward
     pass recomputes the block — INCLUDING the cross-shard attention's
@@ -188,15 +197,16 @@ def apply_lm(
     # the same code runs full-width and tensor-parallel.
     heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
     reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
+    promote = col_promote if col_promote is not None else (lambda x: x)
 
     def block(h, blk):
-        x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+        x = promote(_layernorm(h, blk["ln1_g"], blk["ln1_b"]))
         q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
         k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
         v = heads(x @ blk["wv"])
         a = attn_fn(q, k, v)
         h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
-        x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+        x = promote(_layernorm(h, blk["ln2_g"], blk["ln2_b"]))
         return h + reduce_(
             jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
         ) + blk["b2"]
@@ -222,6 +232,7 @@ def lm_loss_sums(
     compute_dtype=None,
     remat: bool = False,
     row_reduce=None,
+    col_promote=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted next-token cross-entropy as ``(sum_ce, sum_weights)`` —
     the accumulator form, so the caller owns normalization: a single
@@ -232,7 +243,7 @@ def lm_loss_sums(
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
         positions=positions, compute_dtype=compute_dtype, remat=remat,
-        row_reduce=row_reduce,
+        row_reduce=row_reduce, col_promote=col_promote,
     )
     logprobs = jax.nn.log_softmax(logits)
     ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -253,6 +264,7 @@ def lm_correct_sums(
     compute_dtype=None,
     remat: bool = False,
     row_reduce=None,
+    col_promote=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Weighted top-1 next-token hits as ``(sum_correct, sum_weights)``
     (accumulator form, same contract as :func:`lm_loss_sums` — and the
@@ -263,7 +275,7 @@ def lm_correct_sums(
     logits = apply_lm(
         params, tokens, spec, attn_fn=attn_fn, pos_offset=pos_offset,
         positions=positions, compute_dtype=compute_dtype, remat=remat,
-        row_reduce=row_reduce,
+        row_reduce=row_reduce, col_promote=col_promote,
     )
     hits = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
     w = weights.astype(jnp.float32)

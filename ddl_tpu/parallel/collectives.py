@@ -81,6 +81,65 @@ def unflatten_params(flat: jax.Array, spec: FlatSpec) -> dict[str, jax.Array]:
 
 
 # ---------------------------------------------------------------------------
+# Tensor-parallel conjugate pair (Megatron f/g)
+# ---------------------------------------------------------------------------
+
+
+def tp_allreduce(axis: str | tuple[str, ...]):
+    """Megatron's ``g`` operator: all-reduce FORWARD, identity BACKWARD.
+
+    Completes a row-parallel matmul's partial sums (the ``wo``/``w2``
+    outputs in strategies/seq.py's tensor parallelism). The backward is
+    identity because the psum's output is consumed identically by every
+    tp member — its cotangent is already tp-invariant, and re-reducing it
+    would scale gradients by the tp degree. Written as a ``custom_vjp``
+    (not a bare ``lax.psum``) so the gradient is EXPLICIT: JAX
+    generations disagree about psum's transpose (old: psum again; vma:
+    identity ``pvary``), and a step body that computes LOCAL grads inside
+    ``shard_map`` (the ZeRO-1 bodies, ``check_vma=False``) must not
+    inherit either rule by accident. Conjugate of :func:`tp_promote`."""
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis)
+
+    def fwd(x):
+        return lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+def tp_promote(axis: str | tuple[str, ...]):
+    """Megatron's ``f`` operator: identity FORWARD, all-reduce BACKWARD.
+
+    Marks the point where the tp-replicated residual stream enters
+    column-parallel matmuls (each tp member's branch touches only its own
+    head / d_ff shard): the forward is free, but the branch cotangents
+    are PARTIAL sums — one per tp member — and must be psummed so
+    everything upstream (LayerNorms, earlier blocks, the embedding) sees
+    the full gradient. Conjugate of :func:`tp_allreduce`; together the
+    pair makes the tensor-parallel forward/backward correct under ANY
+    psum-transpose regime (see that function's docstring)."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (lax.psum(ct, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+# ---------------------------------------------------------------------------
 # Equal-chunk (ZeRO-1 "flat") path
 # ---------------------------------------------------------------------------
 

@@ -128,6 +128,23 @@ def _mesh_nd(shape: tuple[int, ...], axes: tuple[str, ...], devices) -> Mesh:
     return Mesh(np.asarray(devices).reshape(shape), axes)
 
 
+def _cpu_collective_flags_supported() -> bool:
+    """Whether this jaxlib's XLA knows the CPU collective-rendezvous
+    timeout flags. XLA FATALLY ABORTS the whole process on any unknown
+    flag in XLA_FLAGS ("Unknown flags in XLA_FLAGS", parse_flags_from
+    _env.cc) — with pytest capturing output, that abort is silent — so
+    on older jaxlib these flags must never be set. The flags landed
+    alongside the 0.5 jaxlib line; version-gate rather than probe
+    (probing would need a throwaway subprocess per import)."""
+    try:
+        import jaxlib
+
+        major, minor = (int(x) for x in jaxlib.__version__.split(".")[:2])
+    except Exception:
+        return False
+    return (major, minor) >= (0, 5)
+
+
 def extend_cpu_collective_timeouts(warn_s: int = 120, kill_s: int = 900) -> None:
     """Raise XLA:CPU's in-process collective rendezvous timeouts via
     XLA_FLAGS (effective only BEFORE the CPU backend initializes).
@@ -139,9 +156,12 @@ def extend_cpu_collective_timeouts(warn_s: int = 120, kill_s: int = 900) -> None
     devices each running a multi-second program segment before a
     collective can legitimately exceed that skew — a full-width W=8
     per-worker eval was measured aborting this way. Flags already present
-    in XLA_FLAGS are respected."""
+    in XLA_FLAGS are respected. No-op on jaxlib generations whose XLA
+    predates the flags (unknown XLA_FLAGS are a fatal abort there)."""
     import os
 
+    if not _cpu_collective_flags_supported():
+        return
     flags = os.environ.get("XLA_FLAGS", "")
     add = []
     if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
@@ -194,8 +214,31 @@ def virtual_cpu_mesh(n: int, *, probe: bool = True) -> None:
         import jax.extend.backend as jeb
 
         jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", max(n, 8))
+    set_cpu_device_count(max(n, 8))
     jax.config.update("jax_platforms", "cpu")
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Ask for an ``n``-device virtual CPU platform, whichever way this
+    JAX generation spells it: the ``jax_num_cpu_devices`` config when it
+    exists, else the ``XLA_FLAGS --xla_force_host_platform_device_count``
+    env var (which the CPU client reads at creation — callers must invoke
+    this BEFORE the backend initializes, exactly the contract
+    ``jax_num_cpu_devices`` has anyway)."""
+    import os
+
+    import jax
+
+    from ..compat import has_config
+
+    if has_config("jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    keep = [f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f]
+    keep.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(keep)
 
 
 class AcceleratorTimeout(RuntimeError):

@@ -110,25 +110,40 @@ def _sharded_dims(mesh, pspec) -> list[tuple[int, tuple[str, ...], int]]:
     return out
 
 
-def _axis_positions(mesh, names: tuple[str, ...]) -> np.ndarray:
-    """Sorted unique lexicographic positions (major-to-minor in ``names``
-    order) along the combined axes that THIS process's devices occupy —
-    the n-D generalization of :func:`local_worker_rows`."""
+def _lex_index(mesh, names: tuple[str, ...], coords: dict) -> int:
+    """Lexicographic position (major-to-minor in ``names`` order) of one
+    device's mesh ``coords`` along the combined axes — THE shard-order
+    convention of ``NamedSharding(P(names))``, shared by every staging
+    helper so it can never fork."""
+    lex = 0
+    for a in names:
+        lex = lex * mesh.shape[a] + coords[a]
+    return lex
+
+
+def _local_lex_tuples(mesh, dims) -> set[tuple[int, ...]]:
+    """One scan of the device array: for every device THIS process owns,
+    its tuple of lex positions along each of ``dims``' combined axes."""
     import jax
 
     pid = jax.process_index()
     axes = list(mesh.axis_names)
-    sizes = [mesh.shape[a] for a in names]
-    pos = set()
+    got = set()
     for idx in np.ndindex(*mesh.devices.shape):
         if mesh.devices[idx].process_index != pid:
             continue
         coords = dict(zip(axes, idx))
-        lex = 0
-        for a, s in zip(names, sizes):
-            lex = lex * s + coords[a]
-        pos.add(lex)
-    return np.asarray(sorted(pos), dtype=np.int64)
+        got.add(tuple(_lex_index(mesh, names, coords)
+                      for _, names, _ in dims))
+    return got
+
+
+def _axis_positions(mesh, names: tuple[str, ...]) -> np.ndarray:
+    """Sorted unique lexicographic positions (major-to-minor in ``names``
+    order) along the combined axes that THIS process's devices occupy —
+    the n-D generalization of :func:`local_worker_rows`."""
+    tuples = _local_lex_tuples(mesh, [(0, names, 0)])
+    return np.asarray(sorted(t[0] for t in tuples), dtype=np.int64)
 
 
 def local_slice(host_array, dim: int, num_shards: int, rows) -> np.ndarray:
@@ -141,6 +156,38 @@ def local_slice(host_array, dim: int, num_shards: int, rows) -> np.ndarray:
     return np.take(np.asarray(host_array), idx, axis=dim)
 
 
+def _check_rectangular(mesh, dims) -> list[np.ndarray]:
+    """Per-sharded-dim positions of THIS process's devices, after
+    verifying they form a full cartesian product (a "rectangle") over
+    the sharded dims. ``make_array_from_process_local_data`` consumes
+    one contiguous block per dim, so a process whose devices cover e.g.
+    positions {(0,0), (1,1)} of a 2-sharded-dim layout has no block to
+    hand it — that topology needs a different process->device
+    assignment, not silent mis-staging."""
+    import itertools
+
+    import jax
+
+    # ONE device scan yields both sides of the comparison: the per-dim
+    # position sets (each dim's projection of the tuples — exactly what
+    # _axis_positions would report) and the actual tuple coverage.
+    got = _local_lex_tuples(mesh, dims)
+    per_dim = [
+        np.asarray(sorted({t[i] for t in got}), dtype=np.int64)
+        for i in range(len(dims))
+    ]
+    want = set(itertools.product(*(p.tolist() for p in per_dim)))
+    if got != want:
+        raise ValueError(
+            f"process {jax.process_index()}'s devices cover sharded-dim "
+            f"positions {sorted(got)}, not the rectangular block "
+            f"{sorted(want)} that per-dim slab staging needs; choose a "
+            "mesh topology whose per-process device blocks are "
+            "contiguous over the sharded axes"
+        )
+    return per_dim
+
+
 def put(mesh, pspec, host_array) -> Any:
     """Place a host array onto the global mesh with
     ``NamedSharding(mesh, pspec)``.
@@ -148,9 +195,13 @@ def put(mesh, pspec, host_array) -> Any:
     Single process: plain ``device_put`` (the fast, familiar path).
     Multi-process: every process passes the FULL logical array (datasets
     here are deterministic, so each host materializes the same array);
-    the blocks its devices own are extracted per the sharded axis and
-    handed to ``jax.make_array_from_process_local_data``, which assembles
-    the global ``jax.Array`` without any cross-host transfer.
+    the blocks its devices own are extracted per sharded axis — ANY
+    number of genuinely-sharded dims, which is what lets the 3-D
+    ``[dp, sp, tp]`` mesh span OS processes (tp-replicated data dims
+    keep each extraction an independent slab; ``_check_rectangular``
+    rejects the non-slab topologies up front) — and handed to
+    ``jax.make_array_from_process_local_data``, which assembles the
+    global ``jax.Array`` without any cross-host transfer.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -160,15 +211,9 @@ def put(mesh, pspec, host_array) -> Any:
         return jax.device_put(host_array, sharding)
     dims = _sharded_dims(mesh, pspec)
     local = np.asarray(host_array)
-    if len(dims) > 1:
-        # Supporting >1 genuinely-sharded dim multi-process would need
-        # block (not slab) extraction; no trainer path reaches it (the
-        # 2-D lm mesh is single-controller when data_parallel > 1).
-        raise NotImplementedError(
-            f"multi-process put with {len(dims)} sharded dims ({pspec})"
-        )
-    for dim, names, count in dims:
-        local = local_slice(local, dim, count, _axis_positions(mesh, names))
+    positions = _check_rectangular(mesh, dims)
+    for (dim, _, count), rows in zip(dims, positions):
+        local = local_slice(local, dim, count, rows)
     return jax.make_array_from_process_local_data(sharding, local)
 
 

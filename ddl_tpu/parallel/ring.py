@@ -367,6 +367,13 @@ def _make_wrapper(shard_fn, mesh: Mesh, axis: str, causal: bool):
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
+            # Every spec is sharded, so the replication checker has
+            # nothing to certify here — and pre-vma JAX's checker has no
+            # rule for the causal sweep's lax.cond ("branches of cond
+            # produced mismatched replication types"). Gradients through
+            # this boundary ride ppermute/all_to_all transposes only
+            # (exact on every generation), never a psum.
+            check_vma=False,
         )(q, k, v)
 
     return fn

@@ -38,6 +38,20 @@ Checkpoints store m/v in params-shaped form, so a run can resume across
 zero1 on/off AND across any (dp, sp) topology (elastic, like the CNN
 trainers).
 
+``zero1 x tensor_parallel`` composes both onto the full 3-D mesh via
+the HYBRID sharded optimizer (``_zero1_tp_step_body``): the Megatron
+column/row-sharded block weights keep tp-local Adam state (already
+sharded tp-fold with the weights), while the tp-REPLICATED subtree —
+embed, head, every LayerNorm, b2: the leaves that would otherwise hold
+dp*sp*tp redundant Adam copies — is flattened, reduce-scattered and
+updated shard-resident over the combined (dp, sp) axes, then
+all-gathered (cross-replica weight-update sharding, Xu et al.
+arXiv:2004.13336, on the dp x sp x tp recipe of arXiv:2204.06514).
+Gradient correctness in local-grads mode is owned by the explicit
+Megatron f/g ``custom_vjp`` pair (parallel/collectives.py
+``tp_allreduce``/``tp_promote``) threaded through ``apply_lm`` — no
+gradient ever rides a bare psum transpose.
+
 Same training machinery as the other strategies: device-resident
 ``eval_spans`` span programs (AOT-compiled), ``StepTimer`` percentiles,
 ``--target-accuracy`` early stop, deterministic seeded init.
@@ -48,7 +62,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Literal
+from typing import Any, Literal
 
 import jax
 import jax.flatten_util
@@ -123,8 +137,14 @@ class SeqConfig:
     scheme: Scheme = "ring"
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
     target_accuracy: float | None = None
-    # ZeRO-1 over the same mesh axis: reduce-scatter grads, Adam on each
-    # device's flat chunk (m/v owner-resident), all_gather params.
+    # ZeRO-1 over the combined (dp, sp) axes: reduce-scatter grads, Adam
+    # on each device's flat chunk (m/v owner-resident), all_gather
+    # params. Composes with tensor_parallel > 1 as the HYBRID sharded
+    # optimizer (``_zero1_tp_step_body``): tp-sharded weights keep
+    # tp-local Adam state while the tp-REPLICATED subtree (embed/head/
+    # LNs/b2) flattens and shards over dp x sp — its per-device
+    # optimizer-state and gradient-peak bytes drop /(dp*sp), and its
+    # full grad psum becomes reduce-scatter + all-gather.
     zero1: bool = False
     # Local attention kernel: "xla" = the plain einsum softmax
     # (materializes [B, H, T, T] scores); "flash" = the Pallas flash
@@ -177,11 +197,25 @@ def _vary_axes(config: SeqConfig) -> tuple[str, ...]:
 
 
 def _row_reduce(config: SeqConfig):
-    """The tensor-parallel completion psum for apply_lm's row-sharded
-    matmul outputs (None when tp=1 — no collective inserted)."""
+    """Megatron's ``g`` for apply_lm's row-sharded matmul outputs:
+    all-reduce forward, identity backward (``collectives.tp_allreduce``
+    — an explicit custom_vjp, so the gradient never depends on which
+    psum-transpose rule this JAX generation ships). None when tp=1 —
+    no collective inserted."""
     if config.tensor_parallel == 1:
         return None
-    return lambda x: lax.psum(x, TP_AXIS)
+    return coll.tp_allreduce(TP_AXIS)
+
+
+def _col_promote(config: SeqConfig):
+    """Megatron's ``f`` — ``_row_reduce``'s conjugate: identity forward,
+    all-reduce backward where the tp-replicated residual stream enters
+    the column-sharded matmuls, so the replicated subtree (LNs, embed)
+    receives FULL gradients even in the local-grads step bodies. None
+    when tp=1."""
+    if config.tensor_parallel == 1:
+        return None
+    return coll.tp_promote(TP_AXIS)
 
 
 def _attn_for(config: SeqConfig, platform: str | None = None):
@@ -276,7 +310,7 @@ def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
             params, tokens, targets, weights, config.spec, attn_fn=attn,
             positions=_shard_positions(config, t_local),
             compute_dtype=config.dtype(), remat=config.remat,
-            row_reduce=_row_reduce(config),
+            row_reduce=_row_reduce(config), col_promote=_col_promote(config),
         )
         # Global sums over BOTH axes: sp shards hold different positions,
         # dp rows different sequences. (Eval data replicated over dp
@@ -340,17 +374,7 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
     chunk = coll.chunk_size(plan.total, n_dev)
 
     def step(params, opt: ShardedAdam, tokens, targets, weights):
-        t_local = tokens.shape[1]
-        pos = _shard_positions(config, t_local)
-
-        def local_loss(p):
-            num, den = transformer.lm_loss_sums(
-                p, tokens, targets, weights, config.spec, attn_fn=attn,
-                positions=pos, compute_dtype=config.dtype(),
-                remat=config.remat,
-            )
-            return num / lax.psum(den, AXES)
-
+        local_loss = _local_loss_fn(config, attn, tokens, targets, weights)
         l_local, grads = jax.value_and_grad(local_loss)(params)
         loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
         g_own = coll.reduce_scatter_flat(
@@ -369,23 +393,186 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
     return step
 
 
-def _step_body(config: SeqConfig, platform: str | None = None):
-    """One train step, already inside ``shard_map``: global weighted-CE
-    loss, grads for the replicated params (``shard_map`` transposes the
-    replicated in_spec with an automatic cotangent ``psum`` — the pattern
-    pinned against the oracle by tests/test_lm.py), TF1-Adam update."""
-    loss_sums = _shard_sums(config, transformer.lm_loss_sums, platform)
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class HybridAdam:
+    """Optimizer state for the zero1 x tensor_parallel composition.
 
-    def loss(params, tokens, targets, weights):
-        num, den = loss_sums(params, tokens, targets, weights)
-        return num / den
+    Two placements in one state, mirroring how the weights themselves
+    live on the 3-D mesh:
+
+    - the REPLICATED subtree (embed/head/LayerNorms/b2 — every leaf
+      whose weight is tp-replicated) flattens into ``m_flat``/``v_flat``
+      chunks sharded ``P((dp, sp))``: ``rep_total/(dp*sp)`` elements
+      resident per device, replicated over tp — the cross-replica
+      weight-update sharding of Xu et al. (arXiv:2004.13336) applied to
+      exactly the subtree that still had dp*sp redundant Adam copies;
+    - the tp-SHARDED leaves (wq/wk/wv/wo/w1/b1/w2) keep params-shaped
+      ``m_tp``/``v_tp`` lists placed like the weights (already sharded
+      tp-fold): their optimizer state was never replicated over tp, and
+      re-flattening it over (dp, sp) as well would buy /(dp*sp) at the
+      cost of a second scatter/gather pair per step on the hot path.
+
+    One shared ``step`` drives both parts' bias correction.
+    """
+
+    step: jax.Array  # int32 scalar, replicated
+    m_flat: jax.Array  # [dp*sp*chunk] over P((dp, sp)), tp-replicated
+    v_flat: jax.Array
+    m_tp: list  # tp-sharded leaves, params-shaped (specs = weight specs)
+    v_tp: list
+
+
+class _HybridPlan:
+    """Leaf-aligned split of the LM param tree for zero1 x tp: the
+    tp-SHARDED leaves (PartitionSpec mentions TP_AXIS) keep their tree
+    shapes; the REPLICATED remainder gets a static flatten/unflatten
+    plan (the ``_FlatPlan`` analogue over a leaf subset). Built from
+    the HOST-side init template, so constructing it moves no device
+    data."""
+
+    def __init__(self, template, pspecs):
+        leaves, self.treedef = jax.tree.flatten(template)
+        spec_leaves = jax.tree.flatten(
+            pspecs, is_leaf=lambda s: isinstance(s, P)
+        )[0]
+        assert len(spec_leaves) == len(leaves), "spec/param tree mismatch"
+        self.tp_mask = tuple(s != P() for s in spec_leaves)
+        self.tp_specs = [s for s in spec_leaves if s != P()]
+        rep_template = [
+            np.zeros(np.shape(l), np.float32)
+            for l, m in zip(leaves, self.tp_mask) if not m
+        ]
+        flat, self._unravel_rep = jax.flatten_util.ravel_pytree(rep_template)
+        self.rep_total = int(flat.size)
+
+    def split(self, tree) -> tuple[list, list]:
+        """Tree -> (replicated leaves, tp-sharded leaves), flatten order."""
+        leaves = jax.tree.leaves(tree)
+        rep = [l for l, m in zip(leaves, self.tp_mask) if not m]
+        tp = [l for l, m in zip(leaves, self.tp_mask) if m]
+        return rep, tp
+
+    def merge(self, rep: list, tp: list):
+        """Inverse of :meth:`split`: interleave back into the full tree."""
+        rep_it, tp_it = iter(rep), iter(tp)
+        leaves = [next(tp_it) if m else next(rep_it) for m in self.tp_mask]
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    @staticmethod
+    def flatten_rep(rep: list) -> jax.Array:
+        return jax.flatten_util.ravel_pytree(rep)[0]
+
+    def unflatten_rep(self, flat) -> list:
+        return self._unravel_rep(flat[: self.rep_total])
+
+
+def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
+                        platform: str | None = None):
+    """One hybrid zero1 x tensor_parallel train step inside ``shard_map``
+    (``check_vma=False``). Local grads come out of ``_local_loss_fn``
+    dp/sp-partial and tp-complete (the f/g pair); then each subtree gets
+    the reduction its placement wants:
+
+    - REPLICATED subtree: ONE fused ``psum_scatter`` over the combined
+      (dp, sp) axes both sums the partials and lands each of the dp*sp
+      devices its owned flat chunk (tp peers compute identical chunks —
+      the redundancy is free tp-replication of the result), Adam runs on
+      the chunk (m/v owner-resident: optimizer memory /(dp*sp)), and one
+      ``all_gather`` rebuilds the full subtree — reduce-scatter +
+      all-gather REPLACES the replicated path's full psum of this
+      subtree on the hot path;
+    - tp-SHARDED leaves: one ``psum`` over (dp, sp) per leaf (their tp
+      reduction doesn't exist — each device owns its shard outright),
+      then the SAME TF1-Adam update the replicated path applies, on
+      m/v that live sharded tp-fold with the weights.
+    """
+    attn = _attn_for(config, platform)
+    n_dev = config.data_parallel * config.num_workers
+    chunk = coll.chunk_size(hplan.rep_total, n_dev)
+
+    def step(params, opt: HybridAdam, tokens, targets, weights):
+        local_loss = _local_loss_fn(config, attn, tokens, targets, weights)
+        l_local, grads = jax.value_and_grad(local_loss)(params)
+        loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
+        g_rep, g_tp = hplan.split(grads)
+        p_rep, p_tp = hplan.split(params)
+
+        # Replicated subtree: ZeRO-1 over the combined (dp, sp) axes.
+        g_own = coll.reduce_scatter_flat(
+            hplan.flatten_rep(g_rep), n_dev, AXES, mean=False, chunk=chunk
+        )
+        my_chunk = lax.axis_index(DP_AXIS) * config.num_workers \
+            + lax.axis_index(SP_AXIS)  # lex order, = psum_scatter's split
+        p_own = lax.dynamic_slice(
+            coll.pad_to(hplan.flatten_rep(p_rep), chunk * n_dev),
+            (my_chunk * chunk,), (chunk,),
+        )
+        flat = ShardedAdam(step=opt.step, m=opt.m_flat, v=opt.v_flat)
+        p_new, flat = _adam_flat(p_own, flat, g_own, lr=config.learning_rate)
+        rep_new = hplan.unflatten_rep(
+            lax.all_gather(p_new, AXES, tiled=True)
+        )
+
+        # tp-sharded leaves: full (dp, sp) reduction, tp-local Adam with
+        # the SHARED step counter (flat.step == opt.step + 1 already).
+        g_tp = [lax.psum(g, AXES) for g in g_tp]
+        tp_new, tp_state = adam_update(
+            p_tp, AdamState(step=opt.step, m=opt.m_tp, v=opt.v_tp), g_tp,
+            lr=config.learning_rate,
+        )
+        opt = HybridAdam(step=flat.step, m_flat=flat.m, v_flat=flat.v,
+                         m_tp=tp_state.m, v_tp=tp_state.v)
+        return hplan.merge(rep_new, tp_new), opt, loss
+
+    return step
+
+
+def _local_loss_fn(config: SeqConfig, attn, tokens, targets, weights):
+    """The per-device loss every train-step body differentiates: this
+    shard's scored-token CE sum over the GLOBAL (psum'd) weight total.
+    The division's psum carries no parameter dependence, so the returned
+    gradients are LOCAL — dp/sp-partial sums awaiting ONE explicit
+    reduction chosen by the caller (full ``psum`` for the replicated
+    update, fused ``psum_scatter`` for ZeRO-1) — and tp-COMPLETE (the
+    Megatron f/g custom-vjp pair inside apply_lm owns every
+    tensor-parallel reduction in both directions). No gradient ever
+    rides a bare psum transpose, whose rule differs across JAX
+    generations (compat.py)."""
+    t_local = tokens.shape[1]
+    pos = _shard_positions(config, t_local)
+
+    def local_loss(p):
+        num, den = transformer.lm_loss_sums(
+            p, tokens, targets, weights, config.spec, attn_fn=attn,
+            positions=pos, compute_dtype=config.dtype(),
+            remat=config.remat, row_reduce=_row_reduce(config),
+            col_promote=_col_promote(config),
+        )
+        return num / lax.psum(den, AXES)
+
+    return local_loss
+
+
+def _step_body(config: SeqConfig, platform: str | None = None):
+    """One train step, already inside ``shard_map`` (``check_vma=False``):
+    local grads (see ``_local_loss_fn``), ONE explicit ``psum`` over the
+    (dp, sp) axes — full gradients for replicated leaves, per-shard-full
+    gradients for tp-sharded leaves (their dp/sp partials are
+    tp-shard-local already) — then the TF1-Adam update on state that
+    mirrors the param placement. The pattern is pinned against the
+    single-device oracle by tests/test_lm.py."""
+    attn = _attn_for(config, platform)
 
     def step(params, opt_state, tokens, targets, weights):
-        l, grads = jax.value_and_grad(loss)(params, tokens, targets, weights)
+        local_loss = _local_loss_fn(config, attn, tokens, targets, weights)
+        l_local, grads = jax.value_and_grad(local_loss)(params)
+        loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
+        grads = jax.tree.map(lambda g: lax.psum(g, AXES), grads)
         params, opt_state = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
-        return params, opt_state, l
+        return params, opt_state, loss
 
     return step
 
@@ -418,17 +605,6 @@ class SeqTrainer:
                 raise ValueError(
                     f"tensor_parallel needs d_ff ({config.spec.d_ff}) "
                     f"divisible by tp ({tp})"
-                )
-            if config.zero1:
-                raise ValueError(
-                    "zero1 composes with the dp x sp axes; with "
-                    "tensor_parallel > 1 the optimizer is already "
-                    "sharded tp-fold with the weights — unset one"
-                )
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "tensor_parallel > 1 is single-controller for now "
-                    "(multi-process staging slices one sharded dim)"
                 )
         local_heads = config.spec.num_heads // max(tp, 1)
         if config.scheme == "ulysses" and local_heads % max(W, 1):
@@ -473,11 +649,6 @@ class SeqTrainer:
                 f"data_parallel ({dp}), num_workers ({W}) and "
                 f"tensor_parallel ({tp}) must be >= 1"
             )
-        if dp > 1 and jax.process_count() > 1:
-            raise ValueError(
-                "data_parallel > 1 is single-controller for now "
-                "(multi-process staging slices one sharded dim)"
-            )
         _attn_for(config)  # fail fast: unknown scheme / full-with-sharding
         self.config = config
         self.dataset = dataset
@@ -506,16 +677,40 @@ class SeqTrainer:
         )
         # multihost.put_tree: plain device_put single-process; in a
         # multi-process world every controller materializes the same
-        # deterministic init and the global replicated Array is assembled
-        # from process-local data (no cross-host transfer).
-        self.params = multihost.put_tree(
-            self.mesh, self._pspecs,
-            transformer.init_lm_params(
-                jax.random.PRNGKey(config.seed), config.spec
-            ),
+        # deterministic init and the global Array is assembled from
+        # process-local data (no cross-host transfer; tp-sharded leaves
+        # slice their tp dim per process — multihost.put).
+        host_init = transformer.init_lm_params(
+            jax.random.PRNGKey(config.seed), config.spec
         )
-        self._plan = _FlatPlan(self.params)
-        if config.zero1:
+        self.params = multihost.put_tree(self.mesh, self._pspecs, host_init)
+        # Flatten plans built from the HOST template (building them from
+        # the placed tree would gather the tp shards just to read shapes).
+        self._plan = _FlatPlan(host_init)
+        self._hplan = (
+            _HybridPlan(host_init, self._pspecs)
+            if config.zero1 and tp > 1 else None
+        )
+        if self._hplan is not None:
+            # Hybrid: flat (dp, sp)-sharded chunks for the replicated
+            # subtree + params-shaped tp-sharded m/v for the tp leaves.
+            n_dev = dp * W
+            chunk = coll.chunk_size(self._hplan.rep_total, n_dev)
+            z = np.zeros(n_dev * chunk, np.float32)
+            _, tp_leaves = self._hplan.split(host_init)
+            zs = [np.zeros(np.shape(l), np.float32) for l in tp_leaves]
+            put_tp = lambda zeros: [
+                multihost.put(self.mesh, s, z.copy())
+                for s, z in zip(self._hplan.tp_specs, zeros)
+            ]
+            self.opt_state: Any = HybridAdam(
+                step=multihost.put(self.mesh, P(), np.zeros((), np.int32)),
+                m_flat=multihost.put(self.mesh, P(AXES), z),
+                v_flat=multihost.put(self.mesh, P(AXES), z.copy()),
+                m_tp=put_tp(zs),
+                v_tp=put_tp(zs),
+            )
+        elif config.zero1:
             n_dev = dp * W
             chunk = coll.chunk_size(self._plan.total, n_dev)
             z = np.zeros(n_dev * chunk, np.float32)
@@ -542,16 +737,32 @@ class SeqTrainer:
         ``k`` consecutive batches as ONE device-resident program
         (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``)."""
         seq = P(DP_AXIS, SP_AXIS)  # train batch [B, T]: B over dp, T over sp
-        if self.config.zero1:
+        # EVERY step body runs check_vma=False (local-grads mode): each
+        # body computes unreduced dp/sp gradients and applies its own
+        # explicit reduction (psum / psum_scatter); a replication checker
+        # would auto-psum the replicated-param cotangents and the
+        # explicit reduction would then double-count.
+        if self._hplan is not None:
+            opt_spec = HybridAdam(
+                step=P(), m_flat=P(AXES), v_flat=P(AXES),
+                m_tp=list(self._hplan.tp_specs),
+                v_tp=list(self._hplan.tp_specs),
+            )
+            shard_step = jax.shard_map(
+                _zero1_tp_step_body(self.config, self._hplan,
+                                    self._platform),
+                mesh=self.mesh,
+                in_specs=(self._pspecs, opt_spec, seq, seq, seq),
+                out_specs=(self._pspecs, opt_spec, P()),
+                check_vma=False,
+            )
+        elif self.config.zero1:
             opt_spec = ShardedAdam(step=P(), m=P(AXES), v=P(AXES))
             shard_step = jax.shard_map(
                 _zero1_step_body(self.config, self._plan, self._platform),
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, seq, seq, seq),
                 out_specs=(P(), opt_spec, P()),
-                # Local-grads mode (see _zero1_step_body): the rep checker
-                # would otherwise auto-psum the replicated-param cotangents
-                # and the psum_scatter would double-reduce.
                 check_vma=False,
             )
         else:
@@ -560,6 +771,7 @@ class SeqTrainer:
                 mesh=self.mesh,
                 in_specs=(self._pspecs, self._opt_specs, seq, seq, seq),
                 out_specs=(self._pspecs, self._opt_specs, P()),
+                check_vma=False,
             )
 
         def run(params, opt_state, xs, ys, ws, first):
@@ -587,6 +799,11 @@ class SeqTrainer:
             in_specs=(self._pspecs, P(None, SP_AXIS), P(None, SP_AXIS),
                       P(None, SP_AXIS)),
             out_specs=(P(), P()),
+            # No grads here, but the ring's causal lax.cond defeats
+            # replication checkers that lack a cond rule (pre-vma JAX);
+            # the trailing psums make the outputs replicated by
+            # construction either way.
+            check_vma=False,
         )
 
         def acc(params, tokens, targets, weights):
@@ -626,6 +843,27 @@ class SeqTrainer:
 
     def _opt_for_save(self, opt_state):
         """Convert the live optimizer state to the checkpoint form."""
+        if self._hplan is not None:
+            # Hybrid: gather the flat (dp, sp) chunks AND the tp shards
+            # (replicate_for_host reassembles each tp-sharded leaf), then
+            # interleave back into one params-shaped tree — the same
+            # layout-free form every other mode writes.
+            m_flat, v_flat, m_tp, v_tp = multihost.replicate_for_host(
+                self.mesh,
+                (opt_state.m_flat, opt_state.v_flat,
+                 opt_state.m_tp, opt_state.v_tp),
+            )
+            rebuild = lambda flat, tp: jax.tree.map(
+                np.asarray,
+                self._hplan.merge(
+                    self._hplan.unflatten_rep(jnp.asarray(flat)), list(tp)
+                ),
+            )
+            return AdamState(
+                step=np.asarray(opt_state.step),
+                m=rebuild(m_flat, m_tp),
+                v=rebuild(v_flat, v_tp),
+            )
         if not self.config.zero1:
             return multihost.replicate_for_host(self.mesh, opt_state)
         m, v = multihost.replicate_for_host(
@@ -643,7 +881,35 @@ class SeqTrainer:
 
     def _place_opt(self, opt_tree):
         """Re-place a checkpoint-form optimizer state onto this trainer's
-        mode: replicated AdamState, or flat chunks sharded over the mesh."""
+        mode: replicated AdamState, flat chunks sharded over the mesh, or
+        the hybrid split (elastic across ALL of them: a zero1 x tp save
+        resumes replicated, tp-only, zero1-only, or at another
+        topology — and vice versa)."""
+        if self._hplan is not None:
+            n_dev = self.config.data_parallel * self.config.num_workers
+            chunk = coll.chunk_size(self._hplan.rep_total, n_dev)
+
+            def refit(tree):
+                rep, tp = self._hplan.split(tree)
+                flat = np.pad(
+                    np.asarray(self._hplan.flatten_rep(
+                        [np.asarray(l, np.float32) for l in rep]
+                    )),
+                    (0, n_dev * chunk - self._hplan.rep_total),
+                )
+                return (
+                    multihost.put(self.mesh, P(AXES), flat),
+                    [multihost.put(self.mesh, s, np.asarray(l, np.float32))
+                     for s, l in zip(self._hplan.tp_specs, tp)],
+                )
+
+            m_flat, m_tp = refit(opt_tree.m)
+            v_flat, v_tp = refit(opt_tree.v)
+            return HybridAdam(
+                step=multihost.put(self.mesh, P(),
+                                   np.asarray(opt_tree.step)),
+                m_flat=m_flat, v_flat=v_flat, m_tp=m_tp, v_tp=v_tp,
+            )
         if not self.config.zero1:
             return multihost.put_tree(self.mesh, self._opt_specs, opt_tree)
         n_dev = self.config.data_parallel * self.config.num_workers

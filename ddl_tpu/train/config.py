@@ -92,6 +92,18 @@ class TrainConfig:
                 return "first"
             if mode == "tail":
                 return "first+tail"
+            # Exhaustive by construction: every mode not rewritten above
+            # must already run the first conv as a matmul, or the
+            # conv1_matmul request would be silently dropped — a future
+            # tail-only variant has to be added to the rewrites, and this
+            # check is what makes it fail loudly instead (round-5 advice
+            # #4). A real raise, not an assert: benchmarks run under -O
+            # would strip an assert and silently mislabel a measurement.
+            if mode not in ("first", "first+tail", "all"):
+                raise ValueError(
+                    f"conv_matmul mode {mode!r} does not include the "
+                    "first stage and has no conv1_matmul composition rule"
+                )
         return mode
 
     # Early stop: end training at the first eval whose full-test-set

@@ -169,15 +169,21 @@ def test_async_trainer_end_to_end(small_dataset, small_params):
         batch_size=64,
         keep_prob=1.0,
         eval_every=0,
-        epochs=8,
+        # 24 epochs, not 8: the convergence knee depends on the init
+        # draw, and the random stream behind a fixed seed differs
+        # across JAX generations (jax_threefry_partitionable default
+        # flips) — 8-12 epochs plateau near 0.45 on the 0.4 line while
+        # clearing 0.5 on newer JAX; 24 reaches 1.0 on both (measured).
+        # Same robustness fix as the lm copy-task smoke (tests/test_lm.py).
+        epochs=24,
         learning_rate=3e-3,
     )
     trainer = AsyncTrainer(cfg, small_dataset, init=small_params)
     result = trainer.train(log=lambda s: None)
-    # 8 epochs x 8 rounds x 4 pushes = 256 per-push Adam updates at 3e-3 on
-    # the easy procedural set: must decisively beat chance (10%).
+    # 24 epochs x 8 rounds x 4 pushes = 768 per-push Adam updates at 3e-3
+    # on the easy procedural set: must decisively beat chance (10%).
     assert result.final_accuracy > 0.5
-    assert int(trainer.state.t) == 256
+    assert int(trainer.state.t) == 768
 
 
 def test_per_worker_stale_replica_eval(small_dataset, small_params):

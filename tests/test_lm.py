@@ -115,25 +115,39 @@ def test_sharded_loss_and_grads_match_oracle(scheme, workers):
     mesh = make_mesh_2d(1, workers)  # the trainer's [dp, sp] mesh shape
     sums = _shard_sums(cfg, transformer.lm_loss_sums)
 
-    def sharded_loss(p, tk, tg, w):
+    # The trainer's OWN gradient pattern (_step_body / _local_loss_fn):
+    # local grads of [this shard's CE sum / psum'd weight total], ONE
+    # explicit psum over the mesh axes. No gradient rides a bare
+    # psum transpose, so the pattern is exact on every JAX generation
+    # (compat.py) — the value check still goes through _shard_sums'
+    # psum-normalized program.
+    from ddl_tpu.strategies.seq import AXES, _attn_for, _local_loss_fn
+    from jax import lax
+
+    def body(p, tk, tg, w):
+        local_loss = _local_loss_fn(cfg, _attn_for(cfg), tk, tg, w)
+        l_local, grads = jax.value_and_grad(local_loss)(p)
         num, den = sums(p, tk, tg, w)
-        return num / den
+        return (num / den, lax.psum(l_local, AXES),
+                jax.tree.map(lambda g: lax.psum(g, AXES), grads))
 
     fn = jax.shard_map(
-        jax.value_and_grad(sharded_loss),
+        body,
         mesh=mesh,
         in_specs=(P(), P(None, "sp"), P(None, "sp"), P(None, "sp")),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # local-grads mode: the explicit psum owns it
     )
     seq = NamedSharding(mesh, P(None, "sp"))
     rep = NamedSharding(mesh, P())
-    loss, grads = fn(
+    loss_sums, loss, grads = fn(
         jax.device_put(params, rep),
         jax.device_put(tokens, seq),
         jax.device_put(targets, seq),
         jax.device_put(weights, seq),
     )
     l0, g0 = jax.value_and_grad(oracle_loss)(params)
+    np.testing.assert_allclose(float(loss_sums), float(l0), rtol=1e-4)
     np.testing.assert_allclose(float(loss), float(l0), rtol=1e-4)
     flat, flat0 = jax.tree.leaves(grads), jax.tree.leaves(g0)
     for a, b in zip(flat, flat0):
@@ -167,7 +181,13 @@ def test_seq_trainer_learns_copy_task_ring():
         num_train=256, num_test=64, seq_len=T, vocab=SPEC.vocab, seed=5
     )
     cfg = SeqConfig(
-        epochs=6, batch_size=32, learning_rate=3e-3, eval_every=0,
+        # 10 epochs, not 6: the copy task's phase transition lands
+        # between 6 and 10 depending on the init draw, and the random
+        # STREAM behind a given seed differs across JAX generations
+        # (jax_threefry_partitionable flipped defaults) — 10 clears the
+        # transition on both (measured: 0.13 at 6 vs 0.998 at 10 on the
+        # 0.4 line, same exact numerics as W=1).
+        epochs=10, batch_size=32, learning_rate=3e-3, eval_every=0,
         num_workers=8, scheme="ring", spec=SPEC, seed=1,
     )
     result = SeqTrainer(cfg, ds).train(log=lambda s: None)
@@ -551,11 +571,114 @@ def test_seq_trainer_tp_rejects_bad_configs():
             SeqConfig(num_workers=1, scheme="full", tensor_parallel=2,
                       spec=spec5), ds
         )
-    with pytest.raises(ValueError, match="zero1"):
-        SeqTrainer(
-            SeqConfig(num_workers=2, scheme="ring", tensor_parallel=2,
-                      zero1=True, spec=SPEC), ds
+    # zero1 x tensor_parallel is a SUPPORTED composition (the hybrid
+    # sharded optimizer) — constructing it must NOT raise.
+    SeqTrainer(
+        SeqConfig(num_workers=2, scheme="ring", tensor_parallel=2,
+                  zero1=True, spec=SPEC), ds
+    )
+
+
+def test_seq_trainer_zero1_tp_matches_replicated_tp_on_cube():
+    """The tentpole composition: zero1 x tensor_parallel on the 2x2x2
+    dp x sp x tp cube. The hybrid sharded optimizer (tp-sharded weights
+    keep tp-local Adam; the replicated subtree's Adam lives as flat
+    chunks over the combined dp x sp axes) is the same math as the
+    replicated-Adam tp run — identical trainings agree in final
+    loss/params — and the state actually lives sharded: the replicated
+    subtree's m/v hold rep_total/(dp*sp) elements per device (the
+    ~(dp*sp)x optimizer-memory claim) and each tp leaf's m/v mirrors its
+    weight shard."""
+    ds = synthesize_copy(
+        num_train=64, num_test=32, seq_len=T, vocab=SPEC.vocab, seed=23
+    )
+    base = dict(epochs=2, batch_size=16, learning_rate=1e-3, eval_every=0,
+                num_workers=2, data_parallel=2, tensor_parallel=2,
+                scheme="ring", spec=SPEC, seed=13)
+    rep = SeqTrainer(SeqConfig(**base), ds)
+    hyb = SeqTrainer(SeqConfig(zero1=True, **base), ds)
+    chunk = -(-hyb._hplan.rep_total // 4)  # dp*sp = 4 owners
+    assert hyb.opt_state.m_flat.addressable_shards[0].data.size == chunk
+    _, weight_tp = hyb._hplan.split(hyb.params)
+    for m_leaf, w_leaf in zip(hyb.opt_state.m_tp, weight_tp):
+        assert (m_leaf.addressable_shards[0].data.shape
+                == w_leaf.addressable_shards[0].data.shape)
+    r_rep = rep.train(log=lambda s: None)
+    r_hyb = hyb.train(log=lambda s: None)
+    assert np.isclose(r_hyb.final_loss, r_rep.final_loss, rtol=1e-5), (
+        r_hyb.final_loss, r_rep.final_loss
+    )
+    for a, b in zip(jax.tree.leaves(r_rep.params),
+                    jax.tree.leaves(r_hyb.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
         )
+
+
+def test_seq_trainer_zero1_tp_checkpoint_elastic(tmp_path):
+    """zero1 x tp checkpoints are topology- AND mode-free in both
+    directions: a plain sequence-parallel save resumes under the hybrid
+    zero1 x tp=2 cube (params-shaped m/v re-shard onto flat dp x sp
+    chunks + tp shards on load), and a hybrid save gathers back to the
+    params-shaped host form and resumes under plain tp=1; both match
+    the uninterrupted plain golden run."""
+    ds = synthesize_copy(
+        num_train=32, num_test=16, seq_len=T, vocab=SPEC.vocab, seed=24
+    )
+    base = dict(batch_size=16, learning_rate=1e-3, eval_every=0,
+                scheme="ring", spec=SPEC, seed=14)
+    plain = dict(num_workers=2)
+    hybrid = dict(num_workers=2, data_parallel=2, tensor_parallel=2,
+                  zero1=True)
+    golden = SeqTrainer(SeqConfig(epochs=2, **plain, **base), ds).train(
+        log=lambda s: None
+    )
+    for tag, save_kw, resume_kw in (
+        ("plain->hybrid", plain, hybrid), ("hybrid->plain", hybrid, plain)
+    ):
+        ckdir = str(tmp_path / f"ck_{tag.replace('->', '_')}")
+        SeqTrainer(SeqConfig(epochs=1, **save_kw, **base), ds).train(
+            log=lambda s: None, checkpoint_dir=ckdir
+        )
+        crossed = SeqTrainer(SeqConfig(epochs=2, **resume_kw, **base),
+                             ds).train(
+            log=lambda s: None, checkpoint_dir=ckdir, resume=True
+        )
+        assert crossed.resumed_from_step == 2, tag
+        for a, b in zip(jax.tree.leaves(golden.params),
+                        jax.tree.leaves(crossed.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+                err_msg=tag,
+            )
+
+
+def test_zero1_tp_step_uses_true_reduce_scatter():
+    """The hybrid step's replicated-subtree gradients move via a TRUE
+    fused reduce-scatter over the combined (dp, sp) axes — each device
+    receives only its ~rep_total/(dp*sp)-element chunk — never a
+    full-subtree (or full-flat) all-reduce. Pins the tentpole's
+    collective schedule through the same optimized-HLO audit
+    benchmarks/collective_bytes.py publishes (the LM analogue of
+    test_sync_strategies.test_sharded_step_uses_true_reduce_scatter)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from benchmarks.collective_bytes import audit_lm
+
+    row = audit_lm("zero1", 2, 2, tp=2)
+    rep_total = row["rep_total"]
+    chunk = -(-rep_total // 4)  # dp*sp = 4 chunk owners
+    rs = [o for o in row["collectives"] if o["op"] == "reduce-scatter"]
+    assert any(o["max_elems"] == chunk for o in rs), (chunk, rs)
+    for o in row["collectives"]:
+        if o["op"] == "all-reduce":
+            # Legit all-reduces remain: scalar loss sums, the tp
+            # activation completions, and per-tp-shard weight-grad
+            # reductions — all strictly smaller than the replicated
+            # subtree a regression to psum-everything would move.
+            assert o["max_elems"] < rep_total, o
 
 
 def test_seq_trainer_remat_same_numbers_less_memory():

@@ -21,6 +21,25 @@ from ddl_tpu.parallel import multihost
 from ddl_tpu.parallel.mesh import DP_AXIS, make_mesh
 
 
+# Whether this jaxlib's XLA:CPU client can RUN computations whose arrays
+# span OS processes: the 0.4 line raises "Multiprocess computations
+# aren't implemented on the CPU backend" on the first dispatch
+# (coordination/initialize works — only execution is missing);
+# cross-host CPU collectives (gloo) landed with the 0.5 jaxlib line —
+# the SAME version threshold mesh's collective-flags gate encodes, so
+# reuse it rather than fork the parse.
+from ddl_tpu.parallel.mesh import (  # noqa: E402
+    _cpu_collective_flags_supported as _cpu_multiprocess_supported,
+)
+
+requires_multiprocess_cpu = pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="jaxlib's XLA:CPU predates multi-process computation support "
+           "(\"Multiprocess computations aren't implemented on the CPU "
+           "backend\") — two-OS-process worlds need the 0.5 jaxlib line",
+)
+
+
 def test_local_worker_rows_single_process_owns_all():
     mesh = make_mesh(8)
     np.testing.assert_array_equal(
@@ -87,6 +106,54 @@ def test_put_tree_single_spec_and_spec_tree():
     assert out["b"].sharding.is_fully_replicated
 
 
+class _FakeDev:
+    def __init__(self, pid):
+        self.process_index = pid
+
+
+def _fake_mesh(shape: dict, owner) -> object:
+    """A stand-in Mesh for the pure staging math: ``owner(coords) ->
+    process id`` assigns every device. Lets the multi-dim slab path be
+    pinned without a second OS process (the jaxlib here cannot RUN one
+    — see requires_multiprocess_cpu — but the extraction logic is pure)."""
+    import types
+
+    dims = tuple(shape.values())
+    devs = np.empty(dims, dtype=object)
+    for idx in np.ndindex(*dims):
+        devs[idx] = _FakeDev(owner(dict(zip(shape, idx))))
+    return types.SimpleNamespace(
+        axis_names=tuple(shape), shape=shape, devices=devs
+    )
+
+
+def test_check_rectangular_accepts_slabs_and_rejects_diagonals(monkeypatch):
+    """The 3-D [dp, sp, tp] staging contract: a process whose devices
+    form a full cartesian block over the sharded dims (the tp-world
+    topology — process p owns the sp=p slab, all tp columns) passes and
+    yields per-dim positions; a diagonal assignment (no block to hand
+    ``make_array_from_process_local_data``) is rejected up front."""
+    shape = {"dp": 1, "sp": 2, "tp": 2}
+    slab = _fake_mesh(shape, lambda c: c["sp"])
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # A leaf sharded over BOTH (dp, sp) [dim 0] and tp [dim 1] — the
+    # hybrid optimizer's worst case. Process 1 = sp row 1, every tp.
+    dims = [(0, ("dp", "sp"), 2), (1, ("tp",), 2)]
+    pos = multihost._check_rectangular(slab, dims)
+    np.testing.assert_array_equal(pos[0], [1])
+    np.testing.assert_array_equal(pos[1], [0, 1])
+    # The extraction those positions drive: one slab per dim.
+    a = np.arange(4 * 6).reshape(4, 6)
+    out = multihost.local_slice(a, 0, 2, pos[0])
+    out = multihost.local_slice(out, 1, 2, pos[1])
+    np.testing.assert_array_equal(out, a[2:4, :])
+    # Diagonal ownership: process 0 holds (sp=0, tp=0) and (sp=1, tp=1).
+    diag = _fake_mesh(shape, lambda c: int(c["sp"] != c["tp"]))
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with pytest.raises(ValueError, match="rectangular"):
+        multihost._check_rectangular(diag, dims)
+
+
 def test_multihost_world_process_count_1():
     """The degenerate one-process world, end-to-end in a fresh interpreter:
     jax.distributed.initialize (self-hosted coordinator) -> CLI --multihost
@@ -112,7 +179,8 @@ def test_multihost_initialize_explicit_world(tmp_path):
     code = """
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from ddl_tpu.parallel.mesh import set_cpu_device_count
+set_cpu_device_count(4)
 from ddl_tpu.parallel import multihost
 from ddl_tpu.parallel.mesh import make_mesh
 port = multihost.free_port()
@@ -172,6 +240,7 @@ def _run_world(cmds: list[list[str]], timeout: float) -> list[str]:
     # Sharded Hogwild serve: the two all_to_all exchanges cross processes.
     ("async_sharding", ["--num-ps", "2"]),
 ])
+@requires_multiprocess_cpu
 def test_two_process_world_trains_end_to_end(variant, extra):
     """REAL multi-controller training — two OS processes (the analogue of
     the reference's mpiexec spanning nodes, mnist_sync/run.sh:3) join one
@@ -209,7 +278,8 @@ def test_mesh_skipping_a_process_is_rejected():
     code = f"""
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from ddl_tpu.parallel.mesh import set_cpu_device_count
+set_cpu_device_count(2)
 import sys
 from ddl_tpu.parallel import multihost
 from ddl_tpu.parallel.mesh import make_mesh
@@ -249,6 +319,7 @@ def test_multihost_worker_count_must_split_over_processes():
     # replicate_for_host + logical-order conversion of ZeRO-1 m/v.
     ("sync_sharding", ["--num-ps", "2", "--layout", "flat"]),
 ])
+@requires_multiprocess_cpu
 def test_preemption_agreement_across_processes(tmp_path, variant, extra):
     """SIGTERM delivered to ONE process of a two-process world: the
     preemption flag goes through multihost.agree_flag, so BOTH controllers
@@ -330,6 +401,7 @@ multihost.shutdown()
 """
 
 
+@requires_multiprocess_cpu
 def test_two_process_ring_attention():
     """Ring attention across a REAL two-process world: the ppermute ring
     crosses the OS-process boundary over gloo (the DCN analogue), and the
@@ -345,6 +417,7 @@ def test_two_process_ring_attention():
         assert "RING-WORLD-OK" in out
 
 
+@requires_multiprocess_cpu
 def test_two_process_lm_world_trains_end_to_end():
     """The lm variant across a REAL two-process world: each process owns
     one device of the 2-way sequence-parallel mesh, so every ring-attention
@@ -373,6 +446,43 @@ def test_two_process_lm_world_trains_end_to_end():
     assert payloads[0]["config"]["scheme"] == "ring"
 
 
+@requires_multiprocess_cpu
+def test_two_process_tp_world_trains_end_to_end():
+    """Tensor parallelism across a REAL two-process world — the lifted
+    single-controller restriction: a 1x2x2 [dp, sp, tp] mesh spans two
+    OS processes (two cpu devices each; process p owns the sp=p slab),
+    so every Megatron completion psum rides gloo between tp peers
+    in-process while the ring's ppermute and — with --zero1 — the
+    hybrid sharded optimizer's reduce-scatter/all-gather over the
+    combined (dp, sp) axes cross the process boundary. Staging
+    exercises multihost.put's multi-dim path: tp-sharded param leaves
+    slice their tp dim, the (dp, sp)-flat optimizer chunks slice theirs,
+    and the tp-replicated data dims stay slabs. Both controllers report
+    identical results."""
+    port = multihost.free_port()
+    common = [
+        sys.executable, "-m", "ddl_tpu", "lm", "--multihost",
+        "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+        "--platform", "cpu", "--num-workers", "2", "--tensor-parallel",
+        "2", "--zero1", "--seq-scheme", "ring", "--seq-len", "32",
+        "--vocab", "16", "--d-model", "32", "--heads", "2", "--layers",
+        "2", "--d-ff", "64", "--train-seqs", "32", "--test-seqs", "16",
+        "--batch-size", "16", "--eval-every", "0", "--json",
+    ]
+    outs = _run_world(
+        [common + ["--process-id", str(i)] for i in (0, 1)], timeout=280
+    )
+    payloads = []
+    for i, out in enumerate(outs):
+        assert f"multihost: process {i}/2, 4 global devices" in out
+        payloads.append(json.loads(out.strip().splitlines()[-1]))
+    assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
+    assert payloads[0]["final_accuracy"] == payloads[1]["final_accuracy"]
+    assert payloads[0]["config"]["tensor_parallel"] == 2
+    assert payloads[0]["config"]["zero1"] is True
+
+
+@requires_multiprocess_cpu
 def test_two_process_lm_world_zigzag_matches_contiguous():
     """The balanced zigzag layout across a REAL two-process world: the
     travelling kpos crosses the OS-process boundary with its K/V block,

@@ -158,9 +158,14 @@ def test_ring_attention_trains_end_to_end():
             axis_name=DP_AXIS, axis_size=8, causal=False,
         )
         pred = attn.reshape(B, Tl, Hh * Dd) @ w["o"]
-        # Mean over the GLOBAL sequence: mean of per-shard means is exact
-        # because every shard holds T/8 positions.
-        return jax.lax.pmean(jnp.mean((pred - tgt) ** 2), DP_AXIS)
+        # This shard's LOCAL mean — the caller pmeans value and grads
+        # explicitly (mean of per-shard means is exact because every
+        # shard holds T/8 positions). Keeping the collective OUT of the
+        # differentiated function means no gradient rides a pmean
+        # transpose, whose rule differs across JAX generations
+        # (ddl_tpu.compat) — the same explicit-reduction pattern the
+        # seq trainer's step bodies use.
+        return jnp.mean((pred - tgt) ** 2)
 
     seq = NamedSharding(mesh, P(None, DP_AXIS))
     rep = NamedSharding(mesh, P())
@@ -169,13 +174,19 @@ def test_ring_attention_trains_end_to_end():
     w = jax.device_put(w, rep)
     opt = jax.device_put(adam_init(w), rep)
 
+    def body(w, x, tgt):
+        l_local, grads = jax.value_and_grad(shard_loss)(w, x, tgt)
+        return (jax.lax.pmean(l_local, DP_AXIS),
+                jax.tree.map(lambda g: jax.lax.pmean(g, DP_AXIS), grads))
+
     @jax.jit
     def step(w, opt, x, tgt):
         loss, grads = jax.shard_map(
-            jax.value_and_grad(shard_loss),
+            body,
             mesh=mesh,
             in_specs=(P(), P(None, DP_AXIS), P(None, DP_AXIS)),
             out_specs=(P(), P()),
+            check_vma=False,  # local-grads mode: explicit pmean owns it
         )(w, x, tgt)
         w, opt = adam_update(w, opt, grads, lr=1e-2)
         return w, opt, loss
@@ -327,6 +338,10 @@ def test_ring_attention_zigzag_grads_match_oracle():
         mesh=mesh,
         in_specs=(P(None, DP_AXIS),) * 3,
         out_specs=P(None, DP_AXIS),
+        # All specs sharded (nothing to certify) and the causal zigzag
+        # sub-tile conds defeat pre-vma JAX's checker — same rationale
+        # as ring._make_wrapper.
+        check_vma=False,
     )
 
     def loss_zz(q, k, v):

@@ -182,12 +182,45 @@ def test_bench_cached_last_measured_reads_record(monkeypatch, tmp_path):
     assert got["source"] == "benchmarks/results/bench_tpu.json"
     assert "CACHED" in got["note"] and "NOT measured" in got["note"]
     assert got["recorded_utc"].endswith("Z")
+    # The derived ratio carries FIELD-LOCAL provenance: a driver parsing
+    # .vs_baseline.value can never mistake the stale comparison for a
+    # current one (round-5 verdict weak #6).
+    assert got["vs_baseline"]["value"] == 300.0
+    assert got["vs_baseline"]["measured_utc"] == got["recorded_utc"]
+    assert "stale" in got["vs_baseline"]["note"]
+    # A record without the ratio simply omits the field (no null stub).
+    (results / "bench_tpu.json").write_text(
+        json.dumps({**rec, "vs_baseline": None})
+    )
+    assert "vs_baseline" not in bench.cached_last_measured()
+    # A null-value record is a dead-tunnel artifact, not a hardware
+    # measurement: relaying it as "CACHED from the last successful run"
+    # would launder the failure (round-5 advice #2).
+    (results / "bench_tpu.json").write_text(
+        json.dumps({**rec, "value": None})
+    )
+    assert bench.cached_last_measured() is None
     # Corrupt record -> None, not an exception (the error JSON must
     # still be emitted inside the driver's timeout).
     (results / "bench_tpu.json").write_text("{not json")
     assert bench.cached_last_measured() is None
     (results / "bench_tpu.json").unlink()
     assert bench.cached_last_measured() is None
+
+
+def test_bench_conv_matmul_env_validated_before_probe(monkeypatch):
+    """A BENCH_CONV_MATMUL typo must die as a clean SystemExit at config
+    time — BEFORE the probe window is spent — not as a KeyError deep in
+    jit tracing during the first sweep row (round-5 advice #1)."""
+    import pytest
+
+    import bench
+
+    monkeypatch.setenv("BENCH_CONV_MATMUL", "tails")
+    with pytest.raises(SystemExit, match="tails"):
+        bench._conv_matmul_mode()
+    monkeypatch.setenv("BENCH_CONV_MATMUL", "tail")
+    assert bench._conv_matmul_mode() == "tail"
 
 
 def test_steps_scan_matches_lax_scan():
