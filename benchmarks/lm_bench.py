@@ -152,7 +152,12 @@ def main() -> None:
                     force((params, opt, loss))  # true barrier: host fetch
                     tps.append(k * B * T / (time.perf_counter() - t0))
             except Exception as e:  # noqa: BLE001 — record, don't discard
-                row[impl] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                # Structured exception type alongside the message: the
+                # `failed` ledger must stay attributable post hoc (is a
+                # queued-hardware row a Pallas lowering error or an OOM?)
+                # without parsing a truncated prefix out of the string.
+                row[impl] = {"error_type": type(e).__name__,
+                             "error": f"{type(e).__name__}: {e}"[:300]}
                 print(f"[lm_bench] T={T} {impl} FAILED: {e}",
                       file=sys.stderr)
                 continue

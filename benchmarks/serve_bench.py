@@ -1,0 +1,170 @@
+"""Serving throughput/latency on the real chip (or the virtual mesh).
+
+Measures the PRODUCT serving stack — the same compiled
+``(prefill, decode)`` pair and continuous-batching scheduler
+``python -m ddl_tpu serve`` drives (``ddl_tpu.serve``) — with bench.py's
+methodology: compile excluded via a warmup pass, every timed bracket
+closed by the scheduler's host token fetch (the true barrier).
+
+Three numbers per (slots, tensor_parallel) row, the serving SLO trio:
+
+- **prefill tok/s** — prompt ingestion bandwidth (bucketed full-forward)
+- **decode tok/s/slot** — steady-state per-sequence generation rate
+- **p50/p95/p99 per-token latency** — one decode step emits one token
+  per active slot, so step latency IS per-token latency
+  (``utils.metrics.StepTimer`` percentiles)
+
+    python benchmarks/serve_bench.py --json benchmarks/results/serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Process-start stamp for the wall-clock governor (bench.make_deadline).
+_T0 = time.perf_counter()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, nargs="+", default=[1, 4, 8],
+                    help="continuous-batching widths to sweep")
+    ap.add_argument("--tensor-parallel", type=int, nargs="+", default=[1],
+                    help="tp degrees to sweep (each needs that many devices)")
+    ap.add_argument("--capacity", type=int, default=512)
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--num-prompts", type=int, default=16)
+    ap.add_argument("--prompt-min", type=int, default=16)
+    ap.add_argument("--prompt-max", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="force a JAX platform; '--platform cpu' runs the "
+                         "virtual mesh (hermetic smoke) instead of waiting "
+                         "for the TPU tunnel")
+    ap.add_argument("--json", type=str, default=None)
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from ddl_tpu.parallel.mesh import virtual_cpu_mesh
+
+        virtual_cpu_mesh(max(args.tensor_parallel), probe=False)
+    else:
+        from ddl_tpu.parallel.mesh import wait_backend
+
+        window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 1200))
+        if not wait_backend(
+            window_s,
+            log=lambda m: print(f"[serve_bench] {m}", file=sys.stderr),
+        ):
+            print(json.dumps({"metric": "lm_serve_decode_tokens_per_sec",
+                              "error": "backend unreachable"}))
+            sys.exit(1)
+
+    import jax
+
+    import bench
+    from ddl_tpu.data.lm import synthesize_prompts
+    from ddl_tpu.models.transformer import LMSpec
+    from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+
+    spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
+                  num_heads=args.heads, num_layers=args.layers,
+                  d_ff=args.d_ff)
+    platform = jax.devices()[0].platform
+    prompts = synthesize_prompts(
+        num=args.num_prompts, min_len=args.prompt_min,
+        max_len=args.prompt_max, vocab=args.vocab, seed=0,
+    )
+    if args.prompt_max + args.max_new_tokens > args.capacity:
+        sys.exit(f"--prompt-max {args.prompt_max} + --max-new-tokens "
+                 f"{args.max_new_tokens} exceeds --capacity {args.capacity}")
+
+    # Wall-clock governor: rows shed WHOLE when the budget runs low (the
+    # first row is unconditional), and whatever was measured still emits
+    # as a parseable artifact — the lm_bench deadline discipline.
+    left = bench.make_deadline("SERVE_BENCH_DEADLINE_S", 2400, t0=_T0)
+    rows = {}
+    failed = {}
+    skipped = []
+    measured = 0
+    for tp in args.tensor_parallel:
+        for slots in args.slots:
+            tag = f"tp{tp}_slots{slots}"
+            if measured and left() < 180:
+                skipped.append(tag)
+                print(f"[serve_bench] SKIP {tag} (deadline)", file=sys.stderr)
+                continue
+            requests = [
+                Request(id=i, prompt=p, max_new_tokens=args.max_new_tokens)
+                for i, p in enumerate(prompts)
+            ]
+            try:
+                eng = InferenceEngine(ServeConfig(
+                    spec=spec, slots=slots, capacity=args.capacity,
+                    tensor_parallel=tp, temperature=args.temperature,
+                    compute_dtype="bfloat16" if platform == "tpu" else None,
+                ))
+                sched = Scheduler(eng)
+                # Compile outside the timed run (the shared methodology
+                # helper — one definition for the CLI and this bench).
+                sched.warmup(requests)
+                _, stats = sched.run(requests)
+            except Exception as e:  # noqa: BLE001 — record, don't discard
+                failed[tag] = {"error_type": type(e).__name__,
+                               "error": str(e)[:300]}
+                print(f"[serve_bench] {tag} FAILED: {e}", file=sys.stderr)
+                continue
+            lat = stats.latency
+            rows[tag] = {
+                "slots": slots,
+                "tensor_parallel": tp,
+                "prefill_tokens_per_s": round(stats.prefill_tokens_per_s, 1),
+                "decode_tokens_per_s": round(stats.decode_tokens_per_s, 1),
+                "decode_tokens_per_s_per_slot":
+                    round(stats.decode_tokens_per_s_per_slot, 2),
+                "decode_steps": stats.decode_steps,
+                "latency_ms": {"p50": round(lat.p50_ms, 2),
+                               "p95": round(lat.p95_ms, 2),
+                               "p99": round(lat.p99_ms, 2)},
+            }
+            measured += 1
+            print(f"[serve_bench] {tag}: prefill "
+                  f"{stats.prefill_tokens_per_s:,.0f} tok/s, decode "
+                  f"{stats.decode_tokens_per_s_per_slot:.1f} tok/s/slot, "
+                  f"p99 {lat.p99_ms:.1f}ms", file=sys.stderr)
+
+    out = {
+        "metric": "lm_serve_decode_tokens_per_sec",
+        "platform": platform,
+        "spec": {"d_model": spec.d_model, "heads": spec.num_heads,
+                 "layers": spec.num_layers, "d_ff": spec.d_ff,
+                 "vocab": spec.vocab, "params": spec.num_params()},
+        "capacity": args.capacity,
+        "max_new_tokens": args.max_new_tokens,
+        "num_prompts": args.num_prompts,
+        "results": rows,
+        "skipped_for_deadline": skipped,
+        "failed": failed,
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,10 @@ VARIANTS = (
     # Ulysses attention over the mesh; strategies/seq.py). The reference
     # has no sequence axis anywhere (SURVEY.md §5).
     "lm",
+    # The inference half: KV-cache autoregressive decode with tp-sharded
+    # continuous batching (ddl_tpu.serve) — loads params-only from any
+    # trained topology's checkpoint.
+    "serve",
 )
 
 
@@ -214,6 +218,36 @@ def build_parser() -> argparse.ArgumentParser:
                          "keep tp-local Adam state, the tp-replicated "
                          "subtree — embed/head/LayerNorms — shards its "
                          "Adam state over dp x sp)")
+    sv = p.add_argument_group(
+        "serve options",
+        "the 'serve' variant runs KV-cache autoregressive decode with "
+        "continuous batching (ddl_tpu.serve) over a deterministic "
+        "seeded prompt set; the model flags (--vocab/--d-model/--heads/"
+        "--layers/--d-ff), --tensor-parallel, --seed, --bf16/--fp32 and "
+        "--json apply as usual; --checkpoint-dir loads params-only from "
+        "a training checkpoint of ANY topology (no optimizer state "
+        "required)",
+    )
+    sv.add_argument("--slots", type=int, default=4,
+                    help="continuous-batching width: concurrent sequences "
+                         "decoded per step")
+    sv.add_argument("--capacity", type=int, default=256,
+                    help="KV-cache rows per slot — bounds prompt + "
+                         "generated length")
+    sv.add_argument("--max-new-tokens", type=int, default=32,
+                    help="tokens generated per request")
+    sv.add_argument("--num-prompts", type=int, default=8,
+                    help="size of the seeded synthetic prompt set "
+                         "(data.lm.synthesize_prompts)")
+    sv.add_argument("--prompt-min", type=int, default=4,
+                    help="minimum synthetic prompt length")
+    sv.add_argument("--prompt-max", type=int, default=48,
+                    help="maximum synthetic prompt length")
+    sv.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature; 0 = greedy decode")
+    sv.add_argument("--top-k", type=int, default=0,
+                    help="restrict sampling to the k highest logits; "
+                         "0 = full vocab (temperature > 0 only)")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -416,21 +450,46 @@ def _fatal_timeout(e) -> "int":
     os._exit(1)
 
 
+# Flag-hygiene groups: every flag from another variant's group that was
+# changed from its parser default is rejected, so a typo fails loudly
+# instead of silently running without its effect. ONE list per group —
+# the lm and serve reject lists compose from these, so adding a flag to
+# a group protects every other variant at once.
+_MNIST_ONLY_DESTS = (
+    "num_ps", "layout", "keep_prob", "staleness_seed", "data",
+    "synthetic_train", "synthetic_test", "fused_adam", "conv1_matmul",
+    "conv_matmul", "conv_channels", "fc_sizes", "tiny", "reference_compat",
+)
+# Training-only flags (lm group + the shared training machinery): the
+# serving mesh has no data/sequence axis and runs no optimizer.
+_TRAIN_ONLY_DESTS = (
+    "seq_scheme", "seq_len", "train_seqs", "test_seqs", "target_accuracy",
+    "attn_impl", "remat", "seq_layout", "data_parallel", "zero1",
+    "num_workers", "epochs", "batch_size", "lr", "eval_every",
+    "checkpoint_every", "resume", "dispatch_timeout", "profile",
+)
+_SERVE_ONLY_DESTS = (
+    "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
+    "prompt_max", "temperature", "top_k",
+)
+
+
+def _reject_foreign_flags(args, variant: str, dests) -> None:
+    defaults = build_parser()
+    for dest in dests:
+        if getattr(args, dest) != defaults.get_default(dest):
+            raise SystemExit(
+                f"--{dest.replace('_', '-')} does not apply to the "
+                f"{variant} variant"
+            )
+
+
 def _run_lm(args) -> int:
     """The ``lm`` variant: sequence-parallel decoder-LM training on the
     procedural copy task (platform/multihost setup already done by
-    ``main``). Reuses the shared flags; every MNIST-only flag that was
-    changed from its parser default is rejected, so a typo fails loudly
-    instead of silently training without its effect."""
-    defaults = build_parser()
-    for dest in ("num_ps", "layout", "keep_prob", "staleness_seed", "data",
-                 "synthetic_train", "synthetic_test", "fused_adam",
-                 "conv1_matmul", "conv_matmul", "conv_channels", "fc_sizes",
-                 "tiny", "reference_compat"):
-        if getattr(args, dest) != defaults.get_default(dest):
-            raise SystemExit(
-                f"--{dest.replace('_', '-')} does not apply to the lm variant"
-            )
+    ``main``). Reuses the shared flags; MNIST-only and serve-only flags
+    fail loudly (see ``_reject_foreign_flags``)."""
+    _reject_foreign_flags(args, "lm", _MNIST_ONLY_DESTS + _SERVE_ONLY_DESTS)
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
     from .data.lm import synthesize_copy
@@ -534,6 +593,125 @@ def _run_lm(args) -> int:
     return 0
 
 
+def _run_serve(args) -> int:
+    """The ``serve`` variant: continuous-batching KV-cache decode over a
+    deterministic seeded prompt set (platform setup already done by
+    ``main``). MNIST-only and training-only flags fail loudly (see
+    ``_reject_foreign_flags``)."""
+    _reject_foreign_flags(args, "serve",
+                          _MNIST_ONLY_DESTS + _TRAIN_ONLY_DESTS)
+    if args.multihost:
+        raise SystemExit(
+            "serve is single-controller (one process drives the tp mesh); "
+            "--multihost does not apply"
+        )
+    from .data.lm import synthesize_prompts
+    from .models.transformer import LMSpec
+    from .serve import InferenceEngine, Request, Scheduler, ServeConfig
+    from .train.trainer import checkpoint_file
+
+    if args.tensor_parallel < 1:
+        raise SystemExit(
+            f"--tensor-parallel must be >= 1, got {args.tensor_parallel}"
+        )
+    _ensure_devices(args.tensor_parallel, allow_fallback=args.platform is None,
+                    reason="drop --platform to allow the virtual-CPU-mesh "
+                           "fallback")
+    spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
+                  num_heads=args.heads, num_layers=args.layers,
+                  d_ff=args.d_ff)
+    cfg = ServeConfig(
+        spec=spec,
+        slots=args.slots,
+        capacity=args.capacity,
+        tensor_parallel=args.tensor_parallel,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        seed=args.seed,
+        compute_dtype=_resolve_dtype(args),
+    )
+    if args.top_k and args.temperature <= 0:
+        # Same flag hygiene as the variant-group rejects above: greedy
+        # decode never reaches the top-k branch, so the flag would be
+        # silently ignored.
+        raise SystemExit(
+            "--top-k requires --temperature > 0 (greedy decode ignores it)"
+        )
+    if args.max_new_tokens < 1:
+        raise SystemExit(
+            f"--max-new-tokens must be >= 1, got {args.max_new_tokens}"
+        )
+    if args.prompt_max + args.max_new_tokens > args.capacity:
+        raise SystemExit(
+            f"serve config error: --prompt-max {args.prompt_max} + "
+            f"--max-new-tokens {args.max_new_tokens} exceeds --capacity "
+            f"{args.capacity}"
+        )
+    # Validate the checkpoint path BEFORE building the engine (a typo'd
+    # path must not cost a full param init + placement), and hand the
+    # loaded host tree straight to the constructor (no throwaway random
+    # init is ever placed).
+    ckpt = checkpoint_file(args.checkpoint_dir)
+    if ckpt is not None:
+        import os
+
+        if not os.path.exists(ckpt):
+            raise SystemExit(f"no checkpoint at {ckpt}")
+    try:
+        engine = (InferenceEngine.from_checkpoint(cfg, ckpt)
+                  if ckpt is not None else InferenceEngine(cfg))
+    except (ValueError, KeyError) as e:
+        raise SystemExit(f"serve config error: {e}")
+    if ckpt is not None:
+        print(f"[ddl_tpu] serving params from {ckpt} (params-only load)")
+    try:
+        prompts = synthesize_prompts(
+            num=args.num_prompts, min_len=args.prompt_min,
+            max_len=args.prompt_max, vocab=args.vocab, seed=args.seed,
+        )
+    except ValueError as e:
+        raise SystemExit(f"serve config error: {e}")
+    requests = [
+        Request(id=i, prompt=pr, max_new_tokens=args.max_new_tokens)
+        for i, pr in enumerate(prompts)
+    ]
+    scheduler = Scheduler(engine)
+    # Compile outside the reported run: the printed/JSON latency
+    # percentiles and tok/s must measure serving, not jit (the shared
+    # serve_bench/BASELINE.md methodology).
+    scheduler.warmup(requests)
+    done, stats = scheduler.run(requests)
+    for i in sorted(done):
+        c = done[i]
+        print(f"request {i}: prompt {c.prompt_len} tokens -> "
+              f"{len(c.tokens)} generated {c.tokens[:8]}"
+              f"{'...' if len(c.tokens) > 8 else ''}")
+    lat = stats.latency
+    print(f"prefill {stats.prefill_tokens_per_s:.0f} tok/s | decode "
+          f"{stats.decode_tokens_per_s_per_slot:.1f} tok/s/slot "
+          f"({stats.slots} slots) | per-token latency p50 "
+          f"{lat.p50_ms:.1f}ms p95 {lat.p95_ms:.1f}ms p99 {lat.p99_ms:.1f}ms")
+    if args.json:
+        print(json.dumps({
+            "variant": "serve",
+            "config": dataclasses.asdict(cfg),
+            "num_prompts": args.num_prompts,
+            "max_new_tokens": args.max_new_tokens,
+            "completions": {
+                str(i): {"prompt_len": done[i].prompt_len,
+                         "tokens": done[i].tokens}
+                for i in sorted(done)
+            },
+            "prefill_tokens_per_s": stats.prefill_tokens_per_s,
+            "decode_tokens_per_s_per_slot":
+                stats.decode_tokens_per_s_per_slot,
+            "decode_steps": stats.decode_steps,
+            "latency_ms": {"p50": lat.p50_ms, "p95": lat.p95_ms,
+                           "p99": lat.p99_ms},
+        }))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform:
@@ -580,8 +758,13 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(f"[ddl_tpu] multihost: process {jax.process_index()}/"
               f"{jax.process_count()}, {len(jax.devices())} global devices")
+    if args.variant == "serve":
+        return _run_serve(args)
     if args.variant == "lm":
         return _run_lm(args)
+    # MNIST variants get the same loud-fail hygiene for the serve-only
+    # flags (a typo'd `sync --slots 8` must not silently train).
+    _reject_foreign_flags(args, args.variant, _SERVE_ONLY_DESTS)
     from .data import load_mnist
 
     dataset = load_mnist(

@@ -83,3 +83,35 @@ def synthesize_copy(
     tr = make(num_train, rng)
     te = make(num_test, rng)
     return LMDataset(*tr, *te)
+
+
+def synthesize_prompts(
+    num: int = 16,
+    min_len: int = 4,
+    max_len: int = 48,
+    vocab: int = 64,
+    seed: int = 0,
+) -> list[np.ndarray]:
+    """Deterministic variable-length prompt set for serving tests and
+    benchmarks (``ddl_tpu.serve``), so decode-parity and batching tests
+    never hand-roll inputs: one seed, one prompt list, everywhere.
+
+    Each prompt is ``[BOS, payload...]`` — token 0 reserved as BOS (the
+    copy-task convention, :func:`synthesize_copy`), payload uniform in
+    ``[1, vocab)``; lengths uniform in ``[min_len, max_len]``. Returns
+    int32 arrays (a LIST, not a padded matrix — variable length is the
+    point: the serving stack owns its own padding/bucketing)."""
+    if not 1 <= min_len <= max_len:
+        raise ValueError(f"need 1 <= min_len <= max_len, got "
+                         f"{min_len}/{max_len}")
+    if vocab < 2:
+        raise ValueError(f"vocab {vocab} too small for payload + BOS")
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(min_len, max_len + 1, size=num)
+    return [
+        np.concatenate([
+            np.zeros(1, np.int32),
+            rng.integers(1, vocab, size=int(n) - 1, dtype=np.int32),
+        ])
+        for n in lens
+    ]

@@ -1,0 +1,39 @@
+"""Mesh partition specs for the LM param tree — shared by training and
+serving.
+
+The Megatron column/row assignment of ``models.transformer``'s params
+over a tensor-parallel mesh axis used to live privately in
+``strategies/seq.py``; serving (``ddl_tpu.serve``) needs the SAME
+assignment so a checkpoint trained at any tp re-shards onto a serving
+mesh without a conversion step — one definition, two consumers, so the
+two sides can never drift (a train/serve spec fork would surface as
+silently-wrong decode logits, not an error).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import TP_AXIS
+from .transformer import LMSpec
+
+
+def lm_param_specs(spec: LMSpec, tensor_parallel: int):
+    """PartitionSpec tree for the LM params: a single replicated ``P()``
+    at tp=1 (``multihost.put_tree``'s broadcast form — the pre-tp
+    behavior, byte for byte); the Megatron column/row assignment over
+    ``TP_AXIS`` otherwise. Column shards (wq/wk/wv/w1 + b1) put H/tp
+    heads and d_ff/tp hidden units on each device; row shards (wo/w2)
+    consume them; everything touching the full-width residual stream
+    (LNs, embed, head, b2) stays replicated."""
+    if tensor_parallel == 1:
+        return P()
+    col, row = P(None, TP_AXIS), P(TP_AXIS, None)
+    blk = {"ln1_g": P(), "ln1_b": P(), "wq": col, "wk": col, "wv": col,
+           "wo": row, "ln2_g": P(), "ln2_b": P(),
+           "w1": col, "b1": P(TP_AXIS), "w2": row, "b2": P()}
+    return {
+        "embed": P(),
+        "blocks": [dict(blk) for _ in range(spec.num_layers)],
+        "lnf_g": P(), "lnf_b": P(), "head": P(),
+    }

@@ -119,14 +119,21 @@ def rope(x: jax.Array, positions: jax.Array, base: float) -> jax.Array:
     """Rotate dimension pairs of ``x [B, T, H, D]`` by angles
     ``positions[t] * base**(-2i/D)``. ``positions [T]`` are ABSOLUTE —
     a sequence shard passes ``offset + arange(T_local)`` and gets exactly
-    the rotations its positions would receive in the full sequence."""
+    the rotations its positions would receive in the full sequence.
+    ``positions [B, T]`` rotates each batch element by its own positions
+    — the decode path, where each serving slot sits at a different
+    sequence length (ddl_tpu.serve). Positions need no upper bound: the
+    rotation is stateless, so decode may run arbitrarily far past any
+    training length (extrapolation pinned by tests/test_serve.py)."""
     d = x.shape[-1]
     if d % 2:
         raise ValueError(f"head_dim {d} must be even for RoPE")
     freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = positions.astype(jnp.float32)[:, None] * freqs  # [T, D/2]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs  # [.., T, D/2]
+    if angles.ndim == 2:  # shared positions: broadcast over batch
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)  # [B|1, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(x.shape)
@@ -217,6 +224,87 @@ def apply_lm(
         h = block(h, blk)
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])
     return (h @ params["head"]).astype(jnp.float32)
+
+
+def apply_lm_cached(
+    params: Params,
+    tokens: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cache_pos: jax.Array,
+    spec: LMSpec = LMSpec(),
+    *,
+    start: jax.Array,
+    positions: jax.Array | None = None,
+    compute_dtype=None,
+    row_reduce=None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Incremental (KV-cached) forward — the serving twin of
+    :func:`apply_lm`: int tokens ``[B, T]`` -> fp32 logits
+    ``[B, T, vocab]`` plus the updated cache. ``T`` is the number of NEW
+    sequence elements per slot (a whole prompt at prefill, one token per
+    decode step); everything already processed lives in the cache.
+
+    ``cache_k``/``cache_v [num_layers, B, C, H, D]`` are the per-layer
+    ring buffers and ``cache_pos [B, C]`` the absolute position each row
+    holds (``ops.kv_cache.PAD_POS`` = unwritten/stale; the attend masks
+    on positions, so stale rows are invisible). ``start [B]`` is each
+    slot's write cursor: token t lands in row ``(start + t) % C`` at
+    absolute position ``start + t``. ``positions [B, T]`` overrides the
+    per-token absolute positions (RoPE + the stored mask positions)
+    without moving the write rows — pass ``PAD_POS`` at padded prompt
+    tails so they are never attended, or far-past-training values to
+    probe RoPE extrapolation.
+
+    Parity contract: one prefill of ``tokens[:, :n]`` followed by
+    one-token decode steps reproduces full-forward :func:`apply_lm`
+    logits at every position to tight tolerance — the same LN/RoPE/
+    einsum/mask numerics, just read from the cache
+    (tests/test_serve.py pins it for tp=1 and tp=2).
+
+    ``row_reduce`` is the same Megatron ``g`` hook as :func:`apply_lm`
+    (all-reduce over tp of the row-sharded attention/MLP outputs); its
+    conjugate ``f`` is identity in the forward, and this path is never
+    differentiated, so there is no ``col_promote`` here. Under tensor
+    parallelism the caches hold each device's LOCAL head subset — the
+    cache pytree is tp-sharded exactly like ``wq`` (ddl_tpu.serve.cache).
+    """
+    from ..ops import kv_cache
+
+    if compute_dtype is not None:
+        params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
+    h = params["embed"][tokens]  # [B, T, E]
+    b, t, e = h.shape
+    capacity = cache_k.shape[2]
+    rows = (start[:, None] + jnp.arange(t, dtype=start.dtype)) % capacity
+    if positions is None:
+        positions = start[:, None] + jnp.arange(t, dtype=start.dtype)
+    cache_pos = jax.vmap(lambda p, r, v: p.at[r].set(v))(
+        cache_pos, rows, positions.astype(cache_pos.dtype)
+    )
+    heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
+    reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
+
+    for i, blk in enumerate(params["blocks"]):
+        x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+        q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
+        k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
+        v = heads(x @ blk["wv"])
+        ck = kv_cache.append_rows(cache_k[i], k.astype(cache_k.dtype), rows)
+        cv = kv_cache.append_rows(cache_v[i], v.astype(cache_v.dtype), rows)
+        cache_k = cache_k.at[i].set(ck)
+        cache_v = cache_v.at[i].set(cv)
+        a = kv_cache.attend(q, ck.astype(q.dtype), cv.astype(q.dtype),
+                            positions, cache_pos)
+        h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
+        x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+        h = h + reduce_(
+            jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
+        ) + blk["b2"]
+
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)
+    return logits, cache_k, cache_v, cache_pos
 
 
 def lm_loss_sums(

@@ -1,0 +1,92 @@
+"""KV-cache primitives: ring-buffer append + position-masked attend.
+
+The serving half of the sharded-mesh story (ddl_tpu.serve): a trained
+decoder LM answers autoregressively, which means every generated token
+re-attends the whole history — recomputing it per step is O(T^2) per
+token. The standard fix is a **KV cache**: each layer's post-RoPE k and
+pre-projection v rows are written once and re-read on every later step.
+
+This module is the op layer only — two pure functions usable inside
+``shard_map`` (the same contract as ``parallel.collectives``); the cache
+*pytree*, its tp sharding and its donation policy live in
+``ddl_tpu.serve.cache``.
+
+Design decisions:
+
+- **Ring buffer, not concat**: the cache is a fixed ``[B, C, H, D]``
+  buffer updated in place (``.at[rows].set``) — under jit with donated
+  buffers the decode step allocates nothing and its shape never changes,
+  so ONE compiled program serves a request from first token to last
+  (a growing concat would recompile per length). Writes wrap modulo the
+  capacity ``C`` (:func:`append_rows` takes pre-wrapped row indices from
+  the caller), which is what makes the buffer a *ring*.
+- **Positions travel with the rows**: a ``pos [B, C]`` int32 array holds
+  each row's ABSOLUTE token position (``PAD_POS`` where the row is
+  unwritten or stale). Attention masks on ``pos``, never on the row
+  index, so (1) causal masking is exact whatever order rows were
+  written in, (2) a reused slot's stale rows are invisible until
+  overwritten — eviction is free, (3) a wrapped ring degrades to an
+  exact sliding window over the last ``C`` positions, and (4) RoPE's
+  decode-time extrapolation (positions far past training length) needs
+  no separate plumbing — the q position is just large.
+- **Same numerics as the training oracle**: :func:`attend` is
+  ``ring.full_attention``'s einsum/softmax written against a cache —
+  fp32 scores, the same ``-1e30`` mask constant, output in ``v``'s
+  dtype — so incremental decode logits can be pinned against full-
+  forward ``apply_lm`` at tight tolerance (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel position for unwritten/stale cache rows: attend() masks
+# k rows with pos > q_pos, and no real query position reaches int32 max,
+# so a PAD_POS row can never be attended. (Stale k/v VALUES may remain
+# in a reused slot's buffer — masking on position makes them invisible
+# without touching the buffer.)
+PAD_POS = jnp.iinfo(jnp.int32).max
+
+_MASKED = -1e30  # ring.py's mask constant: keeps exp(s - max) NaN-free
+
+
+def append_rows(cache: jax.Array, new: jax.Array, rows: jax.Array) -> jax.Array:
+    """Write ``new [B, T, ...]`` into ``cache [B, C, ...]`` at per-slot
+    row indices ``rows [B, T]`` (int32, already wrapped modulo ``C`` by
+    the caller — ``serve.cache.write_rows`` owns the ring arithmetic).
+    In-place under jit when ``cache`` is donated. Row indices within one
+    slot must be distinct (they are: consecutive positions of one
+    sequence); out-of-range indices are a scatter no-op per XLA's
+    clamp-free scatter semantics — callers pass wrapped rows, never
+    relying on that."""
+    return jax.vmap(lambda c, n, r: c.at[r].set(n))(cache, new, rows)
+
+
+def attend(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    q_pos: jax.Array,
+    k_pos: jax.Array,
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Causal attention of fresh queries against a cache.
+
+    ``q [B, T, H, D]`` at absolute positions ``q_pos [B, T]``;
+    ``k_cache``/``v_cache [B, C, H, D]`` whose row c holds the token at
+    absolute position ``k_pos[b, c]`` (``PAD_POS`` = unwritten/stale).
+    Masks ``k_pos <= q_pos`` — exact causal attention over whatever
+    subset of history the cache holds, independent of row order.
+    fp32 scores/softmax, output in ``v_cache``'s dtype (the
+    ``ring.full_attention`` numerics contract)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) * scale
+    mask = k_pos[:, None, None, :] <= q_pos[:, None, :, None]  # [B,1,T,C]
+    s = jnp.where(mask, s, _MASKED)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache)

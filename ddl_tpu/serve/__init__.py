@@ -1,0 +1,29 @@
+"""Serving: KV-cache autoregressive decode with tp-sharded continuous
+batching — the inference half of the sharded-mesh story.
+
+- ``serve.cache``     — the slot-major ring-buffer KV cache pytree
+- ``serve.engine``    — the jitted (prefill, decode) pair on the tp mesh
+- ``serve.scheduler`` — continuous batching over the engine
+
+Quickstart (also ``python -m ddl_tpu serve --help``)::
+
+    from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+
+    eng = InferenceEngine(ServeConfig(slots=4, capacity=256))
+    eng.load_params("ckpt/ckpt.npz")   # any trained topology, params-only
+    done, stats = Scheduler(eng).run([
+        Request(id=0, prompt=prompt_ids, max_new_tokens=64),
+    ])
+"""
+
+from .engine import InferenceEngine, ServeConfig  # noqa: F401
+from .scheduler import Completion, Request, Scheduler, ServeStats  # noqa: F401
+
+__all__ = [
+    "Completion",
+    "InferenceEngine",
+    "Request",
+    "Scheduler",
+    "ServeConfig",
+    "ServeStats",
+]

@@ -1,0 +1,76 @@
+"""The serving KV cache: a slot-major ring-buffer pytree on the tp mesh.
+
+State layout (one pytree, donated through every decode step so serving
+is allocation-free after warmup):
+
+- ``k``/``v [num_layers, slots, capacity, num_heads, head_dim]`` — the
+  per-layer ring buffers of ``ops.kv_cache``, stacked layer-major so
+  donation and sharding cover the whole cache with one leaf each.
+- ``pos [slots, capacity]`` — the absolute token position each row
+  holds, shared by all layers (every layer writes the same rows);
+  ``ops.kv_cache.PAD_POS`` marks unwritten/stale rows. Attention masks
+  on ``pos``, so evicting a finished sequence is pure host bookkeeping
+  (the slot's rows become invisible the moment a new occupant's prefill
+  resets them — no device work).
+
+Tensor parallelism: under the Megatron column sharding
+(``models.partition.lm_param_specs``) each device computes k/v for its
+LOCAL head subset, so the cache shards over the HEAD dim on the same
+``TP_AXIS`` — cache residency per device drops tp-fold, the serving
+twin of the training-side weight sharding. ``pos`` is head-free and
+stays replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models.transformer import LMSpec
+from ..ops.kv_cache import PAD_POS
+from ..parallel.mesh import TP_AXIS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """See module docstring. A pytree — jit/shard_map/donation ready."""
+
+    k: jax.Array  # [L, S, C, H, D]
+    v: jax.Array  # [L, S, C, H, D]
+    pos: jax.Array  # [S, C] int32, PAD_POS = unwritten/stale
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+
+def host_cache(
+    spec: LMSpec, slots: int, capacity: int, dtype=np.float32
+) -> KVCache:
+    """Fresh host-side cache: zero k/v, every row's position PAD_POS
+    (nothing attendable). The caller places it with
+    ``multihost.put_tree(mesh, cache_specs(tp), host_cache(...))``."""
+    shape = (spec.num_layers, slots, capacity, spec.num_heads, spec.head_dim)
+    return KVCache(
+        k=np.zeros(shape, dtype),
+        v=np.zeros(shape, dtype),
+        pos=np.full((slots, capacity), PAD_POS, np.int32),
+    )
+
+
+def cache_specs(tensor_parallel: int) -> KVCache:
+    """PartitionSpec pytree for the cache: k/v shard their HEAD dim over
+    the tp axis (each device caches exactly the heads its column-sharded
+    ``wq``/``wk``/``wv`` produce); ``pos`` replicated. All-``P()`` at
+    tp=1, mirroring ``lm_param_specs``."""
+    kv = (P(None, None, None, TP_AXIS, None)
+          if tensor_parallel > 1 else P())
+    return KVCache(k=kv, v=kv, pos=P())

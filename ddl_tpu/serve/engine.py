@@ -1,0 +1,337 @@
+"""The inference engine: a jitted ``(prefill, decode)`` pair over the
+serving mesh.
+
+This is the device half of the serving subsystem (the host half — slot
+admission, eviction, batching policy — is ``serve.scheduler``). Two
+compiled programs cover a request's whole life:
+
+- **prefill**: one request's prompt (padded to a power-of-two bucket so
+  a handful of programs serve every length) runs through
+  ``transformer.apply_lm_cached`` in a single forward, writing rows
+  ``0..p-1`` of its slot and sampling the first output token from the
+  last real position's logits. The slot's stale ``pos`` rows are reset
+  to ``PAD_POS`` first, so a reused slot can never leak its previous
+  occupant's history into the new request's attention.
+- **decode**: ONE token per active slot, batched over all slots in a
+  single fixed-shape program — each slot embeds its last token at its
+  own absolute position (``rope`` takes per-slot ``[S, 1]`` positions),
+  appends one cache row, attends its own history, and samples. The
+  cache pytree is donated, so steady-state decode allocates nothing.
+  Free slots ride along (fixed shapes = one compiled program) writing
+  ``PAD_POS`` rows that no later occupant can attend.
+
+Sampling is greedy at ``temperature == 0``, else temperature softmax
+(optionally top-k-truncated) sampled with a key derived ONLY from
+``(seed, request_id, token_index)`` — never from the slot index or the
+step counter — so a request's tokens are bit-identical whether it runs
+alone or continuously batched with strangers at any arrival pattern
+(the scheduler-parity pin, tests/test_serve.py).
+
+Tensor parallelism reuses the training plumbing wholesale: params
+placed by ``models.partition.lm_param_specs``, the cache's head dim
+sharded by ``serve.cache.cache_specs``, and the row-sharded matmul
+outputs completed by ``collectives.tp_allreduce`` inside ``shard_map``
+— serving tp=N is the training forward at tp=N, so a checkpoint from
+ANY trained topology serves on any tp the heads divide by.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models import transformer
+from ..models.partition import lm_param_specs
+from ..models.transformer import LMSpec
+from ..ops.kv_cache import PAD_POS
+from ..parallel import collectives as coll
+from ..parallel import multihost
+from ..parallel.mesh import TP_AXIS, donation_for, make_mesh
+from .cache import KVCache, cache_specs, host_cache
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving topology + sampling policy. ``slots`` is the continuous-
+    batching width (concurrent sequences); ``capacity`` bounds each
+    slot's prompt + generated length (the KV ring's row count)."""
+
+    spec: LMSpec = LMSpec()
+    slots: int = 4
+    capacity: int = 256
+    tensor_parallel: int = 1
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0  # 0 = full vocab (temperature > 0 only)
+    seed: int = 0
+    compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
+
+    def dtype(self):
+        return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
+
+
+def _load_host_params(path, spec: LMSpec):
+    """Params-only host tree from any trainer checkpoint: the template
+    is shapes-only (``jax.eval_shape`` — no arrays are initialized just
+    to be overwritten)."""
+    from ..utils.checkpoint import load_params
+
+    template = jax.eval_shape(
+        lambda: transformer.init_lm_params(jax.random.PRNGKey(0), spec)
+    )
+    host, _, _ = load_params(path, template)
+    return host
+
+
+class InferenceEngine:
+    """Owns the placed params, the cache state, and the compiled
+    program pair. ``params`` is a host pytree (e.g. a fresh init or a
+    ``utils.checkpoint.load_params`` result); ``None`` seeds a random
+    init — the smoke/demo path."""
+
+    def __init__(self, config: ServeConfig, params=None):
+        tp = config.tensor_parallel
+        spec = config.spec
+        if tp < 1:
+            raise ValueError(f"tensor_parallel must be >= 1, got {tp}")
+        if tp > 1:
+            if spec.num_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel needs num_heads ({spec.num_heads}) "
+                    f"divisible by tp ({tp})"
+                )
+            if spec.d_ff % tp:
+                raise ValueError(
+                    f"tensor_parallel needs d_ff ({spec.d_ff}) "
+                    f"divisible by tp ({tp})"
+                )
+        if config.slots < 1 or config.capacity < 2:
+            raise ValueError(
+                f"need slots >= 1 and capacity >= 2, got "
+                f"{config.slots} / {config.capacity}"
+            )
+        if not 0 <= config.top_k <= spec.vocab:
+            raise ValueError(
+                f"top_k must be in [0, vocab={spec.vocab}], got "
+                f"{config.top_k}"
+            )
+        self.config = config
+        # A 1-D tp mesh: serving has no data/sequence axis — the batch
+        # dim is the slot dim, resident whole on every tp member.
+        self.mesh = make_mesh(tp, axis=TP_AXIS)
+        self._pspecs = lm_param_specs(spec, tp)
+        self._cspecs = cache_specs(tp)
+        if params is None:
+            params = transformer.init_lm_params(
+                jax.random.PRNGKey(config.seed), spec
+            )
+        self.params = multihost.put_tree(self.mesh, self._pspecs, params)
+        self._row_reduce = coll.tp_allreduce(TP_AXIS) if tp > 1 else None
+        self._prefill_fns: dict[int, object] = {}
+        self._decode_fn = None
+        self.reset()
+
+    @classmethod
+    def from_checkpoint(cls, config: ServeConfig, path) -> "InferenceEngine":
+        """Build an engine serving a checkpoint's params directly — no
+        throwaway random init is ever placed (the constructor receives
+        the loaded host tree). Same params-only contract as
+        :meth:`load_params`."""
+        return cls(config, params=_load_host_params(path, config.spec))
+
+    # -- state -------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Fresh (empty) cache — every slot free, nothing attendable."""
+        dtype = np.dtype(self.config.compute_dtype or np.float32)
+        self.cache = multihost.put_tree(
+            self.mesh, self._cspecs,
+            host_cache(self.config.spec, self.config.slots,
+                       self.config.capacity, dtype),
+        )
+
+    def load_params(self, path) -> None:
+        """Params-only checkpoint load (``utils.checkpoint.load_params``):
+        accepts a trainer checkpoint from ANY topology — optimizer/step
+        state is ignored if present and not required to exist."""
+        self.params = multihost.put_tree(
+            self.mesh, self._pspecs,
+            _load_host_params(path, self.config.spec),
+        )
+
+    # -- sampling ----------------------------------------------------------
+
+    def _sample(self, logits, request_id, token_index):
+        """One token from one ``[vocab]`` logit row. The PRNG key folds
+        in ONLY (seed, request_id, token_index): batch composition, slot
+        assignment and arrival time cannot change a request's stream."""
+        cfg = self.config
+        if cfg.temperature <= 0.0:
+            return jnp.argmax(logits).astype(jnp.int32)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), request_id),
+            token_index,
+        )
+        scaled = logits / cfg.temperature
+        if cfg.top_k > 0:
+            kth = jnp.sort(scaled)[-cfg.top_k]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+    # -- compiled programs -------------------------------------------------
+
+    def _shard_forward(self):
+        """The cached forward both programs wrap — shape-generic over
+        ``[B, T]`` token blocks: prefill hands it a ``[1, bucket]``
+        slot slice, decode the ``[slots, 1]`` batch."""
+        cfg = self.config
+
+        def body(params, cache: KVCache, tokens, start, positions):
+            logits, k, v, pos = transformer.apply_lm_cached(
+                params, tokens, cache.k, cache.v, cache.pos, cfg.spec,
+                start=start, positions=positions,
+                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+            )
+            return logits, KVCache(k=k, v=v, pos=pos)
+
+        return body
+
+    def _prefill_fn(self, bucket: int):
+        """Compiled prefill for prompts padded to ``bucket`` tokens:
+        ``(params, cache, tokens [1, bucket], length, slot, request_id)
+        -> (next_token, logits [bucket, vocab], cache)``."""
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg = self.config
+        fwd = self._shard_forward()
+
+        def shard_body(params, cache: KVCache, tokens, length, slot):
+            # Slot slice: [L, 1, C, H, D] k/v + [1, C] pos. The pos row
+            # resets to PAD_POS so the previous occupant's rows beyond
+            # this prompt can never be attended (k/v values may remain —
+            # masking on position makes them invisible).
+            sl = KVCache(
+                k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+                v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+                pos=jnp.full((1, cache.pos.shape[1]), PAD_POS, jnp.int32),
+            )
+            t = jnp.arange(bucket, dtype=jnp.int32)
+            # Padded tail positions are PAD_POS: written but never
+            # attendable, and overwritten by the first decode steps.
+            positions = jnp.where(t < length, t, PAD_POS)[None, :]
+            logits, sl = fwd(params, sl, tokens,
+                             jnp.zeros((1,), jnp.int32), positions)
+            cache = KVCache(
+                k=lax.dynamic_update_slice_in_dim(cache.k, sl.k, slot, axis=1),
+                v=lax.dynamic_update_slice_in_dim(cache.v, sl.v, slot, axis=1),
+                pos=lax.dynamic_update_slice_in_dim(
+                    cache.pos, sl.pos, slot, axis=0
+                ),
+            )
+            return logits[0], cache
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pspecs, self._cspecs, P_(), P_(), P_()),
+            out_specs=(P_(), self._cspecs),
+            check_vma=False,
+        )
+
+        def run(params, cache, tokens, length, slot, request_id):
+            logits, cache = shard(params, cache, tokens, length, slot)
+            last = lax.dynamic_index_in_dim(
+                logits, length - 1, axis=0, keepdims=False
+            )
+            # The sampled token is sequence element `length` of this
+            # request — the token_index the PRNG key folds in.
+            nxt = self._sample(last, request_id, length)
+            return nxt, logits, cache
+
+        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode(self):
+        """Compiled decode step: one token for every slot at once.
+        ``(params, cache, last_tokens [S], lengths [S], request_ids [S],
+        active [S]) -> (next_tokens [S], logits [S, vocab], cache)``."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+        fwd = self._shard_forward()
+
+        def shard_body(params, cache, last_tokens, lengths, active):
+            # Free slots still compute (fixed shapes = one program) but
+            # write PAD_POS rows: invisible to any future occupant.
+            positions = jnp.where(active, lengths, PAD_POS)[:, None]
+            logits, cache = fwd(params, cache, last_tokens[:, None],
+                                lengths, positions)
+            return logits[:, 0], cache
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pspecs, self._cspecs, P_(), P_(), P_()),
+            out_specs=(P_(), self._cspecs),
+            check_vma=False,
+        )
+
+        def run(params, cache, last_tokens, lengths, request_ids, active):
+            logits, cache = shard(params, cache, last_tokens, lengths, active)
+            # This step extends each sequence to length+1 tokens; the
+            # sampled token's index is lengths + 1 (prefill sampled
+            # index `length`, decode continues the same numbering).
+            nxt = jax.vmap(self._sample)(logits, request_ids, lengths + 1)
+            return nxt, logits, cache
+
+        self._decode_fn = jax.jit(
+            run, donate_argnums=donation_for(self.mesh, 1)
+        )
+        return self._decode_fn
+
+    # -- host API ----------------------------------------------------------
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        """Smallest power-of-two bucket >= max(prompt_len, 8), capped at
+        capacity — a handful of compiled programs cover every length."""
+        if not 1 <= prompt_len <= self.config.capacity:
+            raise ValueError(
+                f"prompt length {prompt_len} outside [1, capacity="
+                f"{self.config.capacity}]"
+            )
+        b = 8
+        while b < prompt_len:
+            b *= 2
+        return min(b, self.config.capacity)
+
+    def prefill(self, prompt, *, slot: int, request_id: int):
+        """Admit one prompt into ``slot``: writes rows ``0..p-1``,
+        samples sequence element ``p``. Returns ``(next_token int,
+        logits np [p, vocab])`` — the logits of every prompt position,
+        for parity pinning and scoring."""
+        prompt = np.asarray(prompt, np.int32)
+        p = int(prompt.shape[0])
+        bucket = self.prefill_bucket(p)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :p] = prompt
+        nxt, logits, self.cache = self._prefill_fn(bucket)(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.int32(p), jnp.int32(slot), jnp.int32(request_id),
+        )
+        return int(nxt), np.asarray(logits)[:p]
+
+    def decode(self, last_tokens, lengths, request_ids, active):
+        """One batched decode step over all slots. Host arrays in,
+        ``(next_tokens np [S], logits np [S, vocab])`` out; the fetch is
+        the step's true barrier (latency timing hangs off it)."""
+        nxt, logits, self.cache = self._decode()(
+            self.params, self.cache,
+            jnp.asarray(np.asarray(last_tokens, np.int32)),
+            jnp.asarray(np.asarray(lengths, np.int32)),
+            jnp.asarray(np.asarray(request_ids, np.int32)),
+            jnp.asarray(np.asarray(active, bool)),
+        )
+        return np.asarray(nxt), np.asarray(logits)

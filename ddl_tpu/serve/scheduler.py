@@ -1,0 +1,215 @@
+"""Continuous batching: the host-side driver over the engine's
+``(prefill, decode)`` pair.
+
+Static batching (run a batch to completion, then admit the next) leaves
+slots idle as soon as the first sequence finishes; continuous batching
+— the Orca/vLLM scheduling discipline — admits and evicts at TOKEN
+granularity: every step, finished sequences free their slots, waiting
+requests prefill into them, and ONE fixed-shape decode program advances
+every active slot together. The device never sees the churn: admission
+is a prefill into a slot slice, eviction is host bookkeeping (the
+position-masked cache makes stale rows invisible, serve/cache.py).
+
+The scheduler is deliberately pure Python — policy lives here (arrival
+order, slot choice, stop conditions), device work lives in the jitted
+engine. Determinism contract: because sampling keys depend only on
+``(seed, request_id, token_index)`` and slot computation is
+row-independent, a request's output tokens are identical whatever mix
+of strangers shares the batch and whenever it arrives — pinned by
+tests/test_serve.py against per-request isolated runs.
+
+Metrics: prefill tok/s, decode tok/s/slot and per-token latency
+p50/p95/p99 via ``utils.metrics.StepTimer`` (each decode step emits one
+token per active slot, so step latency IS per-token latency).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+import numpy as np
+
+from ..utils.metrics import StepStats, StepTimer
+from .engine import InferenceEngine
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. ``arrival`` is the earliest scheduler
+    step at which it may be admitted — tests and benchmarks stagger
+    arrivals with it; a live frontend would enqueue with ``arrival=0``."""
+
+    id: int
+    prompt: np.ndarray  # int32 [p], p >= 1
+    max_new_tokens: int
+    arrival: int = 0
+
+
+@dataclasses.dataclass
+class Completion:
+    id: int
+    prompt_len: int
+    tokens: list[int]  # generated ids (includes the eos token if hit)
+    admitted_step: int
+    finished_step: int
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Aggregate throughput/latency for one :meth:`Scheduler.run`."""
+
+    prefill_tokens: int
+    prefill_s: float
+    decode_tokens: int
+    decode_steps: int
+    decode_s: float
+    slots: int
+    latency: StepStats  # per-decode-step = per-token percentiles
+
+    @property
+    def prefill_tokens_per_s(self) -> float:
+        return self.prefill_tokens / self.prefill_s if self.prefill_s else 0.0
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        return self.decode_tokens / self.decode_s if self.decode_s else 0.0
+
+    @property
+    def decode_tokens_per_s_per_slot(self) -> float:
+        return self.decode_tokens_per_s / self.slots
+
+
+class Scheduler:
+    """Continuous-batching driver. One instance per engine; ``run`` is
+    synchronous and returns when every request has completed."""
+
+    def __init__(self, engine: InferenceEngine, *, eos_id: int | None = None):
+        self.engine = engine
+        self.eos_id = eos_id
+
+    def warmup(self, requests) -> None:
+        """Compile the decode program and every prefill bucket
+        ``requests`` will need, OUTSIDE any timed run, then reset the
+        engine to a fresh cache — reported latency/throughput must
+        measure serving, not jit compilation (the BASELINE.md
+        methodology; shared by the serve CLI and serve_bench so the two
+        can never measure differently). Clones carry fresh negative ids
+        and generate at most 2 tokens (enough to compile decode whenever
+        the real run will decode at all)."""
+        self.run([
+            dataclasses.replace(
+                r, id=-1 - i, arrival=0,
+                max_new_tokens=min(2, r.max_new_tokens),
+            )
+            for i, r in enumerate(requests)
+        ])
+        self.engine.reset()
+
+    def _validate(self, r: Request) -> None:
+        cap = self.engine.config.capacity
+        p = int(np.asarray(r.prompt).shape[0])
+        if p < 1:
+            raise ValueError(f"request {r.id}: empty prompt")
+        if r.max_new_tokens < 1:
+            raise ValueError(f"request {r.id}: max_new_tokens must be >= 1")
+        if p + r.max_new_tokens > cap:
+            raise ValueError(
+                f"request {r.id}: prompt ({p}) + max_new_tokens "
+                f"({r.max_new_tokens}) exceeds cache capacity {cap}"
+            )
+
+    def run(self, requests) -> tuple[dict[int, Completion], ServeStats]:
+        """Serve ``requests`` to completion. Admission order is (arrival,
+        id) — a deterministic queue, so runs are reproducible."""
+        for r in requests:
+            self._validate(r)
+        ids = [r.id for r in requests]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate request ids in {ids}")
+        eng = self.engine
+        S = eng.config.slots
+        pending = collections.deque(
+            sorted(requests, key=lambda r: (r.arrival, r.id))
+        )
+        # Host-side slot state, passed to the engine every decode step.
+        active = np.zeros(S, bool)
+        lengths = np.zeros(S, np.int32)  # tokens resident in the cache
+        last_tokens = np.zeros(S, np.int32)  # sampled, not yet appended
+        req_ids = np.zeros(S, np.int32)
+        occupant: list[Request | None] = [None] * S
+        generated: list[list[int]] = [[] for _ in range(S)]
+        admitted_at = np.zeros(S, np.int64)
+
+        done: dict[int, Completion] = {}
+        prefill_timer = StepTimer()
+        decode_timer = StepTimer()
+        step = 0
+
+        def finish(s: int) -> None:
+            r = occupant[s]
+            done[r.id] = Completion(
+                id=r.id,
+                prompt_len=int(np.asarray(r.prompt).shape[0]),
+                tokens=list(generated[s]),
+                admitted_step=int(admitted_at[s]),
+                finished_step=step,
+            )
+            active[s] = False
+            occupant[s] = None
+
+        def finished(s: int, token: int) -> bool:
+            return (len(generated[s]) >= occupant[s].max_new_tokens
+                    or (self.eos_id is not None and token == self.eos_id))
+
+        while pending or active.any():
+            # Admit: fill every free slot whose turn has come. Prefill is
+            # per-request (its own timing bucket — a batched-prefill lane
+            # is a future optimization, ROADMAP).
+            for s in range(S):
+                if active[s] or not pending or pending[0].arrival > step:
+                    continue
+                r = pending.popleft()
+                p = int(np.asarray(r.prompt).shape[0])
+                with prefill_timer.step(images=p):
+                    tok, _ = eng.prefill(r.prompt, slot=s, request_id=r.id)
+                occupant[s] = r
+                active[s] = True
+                lengths[s] = p
+                last_tokens[s] = tok
+                req_ids[s] = r.id
+                generated[s] = [tok]
+                admitted_at[s] = step
+                if finished(s, tok):
+                    finish(s)
+            if active.any():
+                with decode_timer.step(images=int(active.sum())):
+                    nxt, _ = eng.decode(last_tokens, lengths, req_ids, active)
+                for s in range(S):
+                    if not active[s]:
+                        continue
+                    lengths[s] += 1  # last_tokens[s] entered the cache
+                    tok = int(nxt[s])
+                    generated[s].append(tok)
+                    last_tokens[s] = tok
+                    if finished(s, tok):
+                        finish(s)
+            step += 1
+            if not active.any() and pending:
+                # Idle gap before the next arrival: every intervening
+                # step would admit and decode nothing, so jump straight
+                # to it instead of spinning one Python iteration per
+                # empty step (pending is (arrival, id)-sorted).
+                step = max(step, pending[0].arrival)
+
+        latency = decode_timer.stats()
+        stats = ServeStats(
+            prefill_tokens=prefill_timer.total_images,
+            prefill_s=prefill_timer.total_s,
+            decode_tokens=decode_timer.total_images,
+            decode_steps=latency.steps,
+            decode_s=decode_timer.total_s,
+            slots=S,
+            latency=latency,
+        )
+        return done, stats

@@ -324,24 +324,13 @@ def _shard_sums(config: SeqConfig, fn, platform: str | None = None):
 
 
 def _param_specs(config: SeqConfig):
-    """PartitionSpec tree for the LM params: a single replicated ``P()``
-    at tp=1 (put_tree's broadcast form — the pre-tp behavior, byte for
-    byte); the Megatron column/row assignment over TP_AXIS otherwise.
-    Column shards (wq/wk/wv/w1 + b1) put H/tp heads and d_ff/tp hidden
-    units on each device; row shards (wo/w2) consume them; everything
-    touching the full-width residual stream (LNs, embed, head, b2)
-    stays replicated."""
-    if config.tensor_parallel == 1:
-        return P()
-    col, row = P(None, TP_AXIS), P(TP_AXIS, None)
-    blk = {"ln1_g": P(), "ln1_b": P(), "wq": col, "wk": col, "wv": col,
-           "wo": row, "ln2_g": P(), "ln2_b": P(),
-           "w1": col, "b1": P(TP_AXIS), "w2": row, "b2": P()}
-    return {
-        "embed": P(),
-        "blocks": [dict(blk) for _ in range(config.spec.num_layers)],
-        "lnf_g": P(), "lnf_b": P(), "head": P(),
-    }
+    """The Megatron column/row (or replicated, tp=1) PartitionSpec tree
+    for this config's params — ONE definition shared with the serving
+    mesh (``models.partition.lm_param_specs``), so a checkpoint trained
+    here re-shards onto ``ddl_tpu.serve`` without conversion."""
+    from ..models.partition import lm_param_specs
+
+    return lm_param_specs(config.spec, config.tensor_parallel)
 
 
 class _FlatPlan:

@@ -65,6 +65,53 @@ def save_checkpoint(
         raise
 
 
+def _read_tree(data, path, like: Any, prefix: str = "") -> Any:
+    """Rebuild ``like``'s structure from an open ``.npz``, reading each
+    leaf at ``prefix + keystr(leaf_path)`` — the one flatten/key/shape-
+    check loop behind both full and subtree loads (extra keys in the
+    file are simply never read)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for p, leaf in flat:
+        key = prefix + jax.tree_util.keystr(p)
+        if key not in data:
+            raise KeyError(f"checkpoint {path} missing leaf {key}")
+        saved = data[key]
+        want = np.shape(leaf)
+        if tuple(saved.shape) != tuple(want):
+            raise ValueError(
+                f"checkpoint leaf {key} has shape {saved.shape}, "
+                f"expected {want}"
+            )
+        leaves.append(saved)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(like), leaves
+    )
+
+
+def load_params(
+    path: str | os.PathLike, like: Any, *, root: str = "params"
+) -> tuple[Any, int | None, dict]:
+    """Load ONLY the params subtree of a checkpoint — the serving path
+    (``ddl_tpu.serve``), which must not require optimizer/step state to
+    be present (a params-only export, a foreign trainer's save, or a
+    trimmed artifact all load fine; extra leaves are simply ignored).
+
+    ``like`` is the params-shaped template (shapes only — a
+    ``jax.eval_shape`` result works). Accepts both layouts the repo
+    writes: a trainer checkpoint whose tree is ``{root: params, ...}``
+    (every trainer saves ``{"params": ..., "opt": ...}``) and a bare
+    params-only file. Returns ``(params, step, extra)`` like
+    :func:`load_checkpoint`.
+    """
+    prefix = f"['{root}']"
+    with np.load(path) as data:
+        meta = json.loads(bytes(data[_META_KEY]).decode())
+        nested = any(k.startswith(prefix) for k in data.files)
+        tree = _read_tree(data, path, like, prefix if nested else "")
+    return tree, meta.get("step"), meta.get("extra", {})
+
+
 def load_checkpoint(
     path: str | os.PathLike, like: Any
 ) -> tuple[Any, int | None, dict]:
@@ -75,21 +122,5 @@ def load_checkpoint(
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data[_META_KEY]).decode())
-        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
-        leaves = []
-        for p, leaf in flat:
-            key = jax.tree_util.keystr(p)
-            if key not in data:
-                raise KeyError(f"checkpoint {path} missing leaf {key}")
-            saved = data[key]
-            want = np.shape(leaf)
-            if tuple(saved.shape) != tuple(want):
-                raise ValueError(
-                    f"checkpoint leaf {key} has shape {saved.shape}, "
-                    f"expected {want}"
-                )
-            leaves.append(saved)
-        tree = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(like), leaves
-        )
+        tree = _read_tree(data, path, like)
     return tree, meta.get("step"), meta.get("extra", {})

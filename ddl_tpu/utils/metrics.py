@@ -25,11 +25,16 @@ class StepStats:
     p95_ms: float
     total_s: float
     images_per_sec: float
+    # Tail latency: the serving SLO percentile (one decode step = one
+    # token per slot, serve/scheduler.py). Defaulted so older pickled/
+    # JSON artifacts missing the field still construct.
+    p99_ms: float = 0.0
 
     def line(self) -> str:
         return (
             f"steps={self.steps} mean={self.mean_ms:.2f}ms "
             f"p50={self.p50_ms:.2f}ms p95={self.p95_ms:.2f}ms "
+            f"p99={self.p99_ms:.2f}ms "
             f"throughput={self.images_per_sec:.0f} img/s"
         )
 
@@ -85,6 +90,7 @@ class StepTimer:
             mean_ms=float(times.mean() * 1e3),
             p50_ms=float(np.percentile(times, 50) * 1e3),
             p95_ms=float(np.percentile(times, 95) * 1e3),
+            p99_ms=float(np.percentile(times, 99) * 1e3),
             total_s=total,
             images_per_sec=float(images.sum()) / total if total else 0.0,
         )

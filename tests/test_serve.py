@@ -1,0 +1,396 @@
+"""Serving subsystem (ddl_tpu/serve/, ops/kv_cache.py,
+transformer.apply_lm_cached, checkpoint.load_params).
+
+The oracle chain extends training's: full-forward ``apply_lm`` is the
+reference numerics, and incremental KV-cache decode must reproduce its
+logits at every position — for tp=1 and tp=2 meshes — while the
+continuous-batching scheduler must produce EXACTLY the tokens each
+request would get decoded alone (sampling keys depend only on
+(seed, request_id, token_index), never on batch composition).
+
+Fast decode-parity smokes stay unmarked (the tier-1 gate); the long
+sweeps (staggered-arrival batching grids, capacity-scale runs) are
+``slow`` so tier-1 stays inside its wall budget on the 2-CPU container.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.data.lm import synthesize_copy, synthesize_prompts
+from ddl_tpu.models import transformer
+from ddl_tpu.models.transformer import TINY_SPEC
+from ddl_tpu.ops import kv_cache
+from ddl_tpu.ops.kv_cache import PAD_POS
+from ddl_tpu.parallel import ring
+from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+
+SPEC = TINY_SPEC
+
+
+def _oracle_attn():
+    return functools.partial(ring.full_attention, causal=True)
+
+
+def _params(seed=0):
+    return transformer.init_lm_params(jax.random.PRNGKey(seed), SPEC)
+
+
+def _empty_cache(b, c, dtype=jnp.float32):
+    shape = (SPEC.num_layers, b, c, SPEC.num_heads, SPEC.head_dim)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.full((b, c), PAD_POS, jnp.int32))
+
+
+# -- ops/kv_cache.py ---------------------------------------------------------
+
+
+def test_kv_attend_matches_full_attention():
+    """attend() against a cache whose rows hold positions 0..T-1 ==
+    full_attention over the same q/k/v — same mask constant, same
+    einsum, same fp32 softmax."""
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(s, (2, 12, 2, 8))
+               for s in jax.random.split(key, 3))
+    pos = jnp.broadcast_to(jnp.arange(12), (2, 12))
+    got = kv_cache.attend(q, k, v, pos, pos)
+    want = ring.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_kv_attend_masks_pad_and_stale_rows():
+    """PAD_POS rows are invisible whatever junk their k/v hold: attend
+    over a cache with junk beyond the valid prefix == attend over the
+    valid prefix alone."""
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (1, 3, 2, 8))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 8, 2, 8))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 8, 2, 8))
+    qpos = jnp.asarray([[0, 1, 2]])
+    kpos = jnp.where(jnp.arange(8) < 3, jnp.arange(8), PAD_POS)[None]
+    got = kv_cache.attend(q, k, v, qpos, kpos)
+    want = ring.full_attention(q, k[:, :3], v[:, :3], causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_kv_append_rows_wraps_as_a_ring():
+    """append_rows at caller-wrapped indices overwrites the oldest rows —
+    the ring-buffer contract (capacity 4, writes at positions 3..5 land
+    in rows 3, 0, 1)."""
+    cache = jnp.zeros((1, 4, 1, 2))
+    new = jnp.arange(6, dtype=jnp.float32).reshape(1, 3, 1, 2) + 1
+    rows = jnp.asarray([[3, 0, 1]])  # (3 + arange(3)) % 4
+    out = np.asarray(kv_cache.append_rows(cache, new, rows))
+    np.testing.assert_array_equal(out[0, 3, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 0, 0], [3, 4])
+    np.testing.assert_array_equal(out[0, 1, 0], [5, 6])
+    assert (out[0, 2] == 0).all()  # untouched
+
+
+# -- apply_lm_cached: decode parity ------------------------------------------
+
+
+def test_incremental_decode_matches_full_forward():
+    """THE serving pin: prefill + one-token decode steps reproduce the
+    full-forward apply_lm logits at EVERY position, tight tolerance."""
+    B, T, C = 2, 24, 32
+    params = _params(1)
+    ds = synthesize_copy(num_train=B, num_test=B, seq_len=T,
+                         vocab=SPEC.vocab, seed=2)
+    tokens = jnp.asarray(ds.tokens)
+    full = transformer.apply_lm(params, tokens, SPEC, attn_fn=_oracle_attn())
+    ck, cv, cpos = _empty_cache(B, C)
+    n = 9  # deliberately not a power of two
+    outs = []
+    lg, ck, cv, cpos = transformer.apply_lm_cached(
+        params, tokens[:, :n], ck, cv, cpos, SPEC,
+        start=jnp.zeros((B,), jnp.int32),
+    )
+    outs.append(lg)
+    for t in range(n, T):
+        lg, ck, cv, cpos = transformer.apply_lm_cached(
+            params, tokens[:, t:t + 1], ck, cv, cpos, SPEC,
+            start=jnp.full((B,), t, jnp.int32),
+        )
+        outs.append(lg)
+    inc = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_rope_extrapolation_beyond_training_length():
+    """RoPE is stateless in position: at offsets far past any training
+    length (1e6+) the shard-consistency property still holds exactly,
+    rotations stay norm-preserving, and prefill-vs-decode position
+    handling agrees — apply_lm at a huge pos_offset == the cached path
+    fed the same absolute positions (the decode-time extrapolation
+    contract, ISSUE 2 satellite)."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 2, 8))
+    big = 1_000_000
+    full = transformer.rope(x, big + jnp.arange(16), 10000.0)
+    shard = transformer.rope(x[:, 8:], big + 8 + jnp.arange(8), 10000.0)
+    np.testing.assert_allclose(np.asarray(full[:, 8:]), np.asarray(shard),
+                               atol=1e-6)
+    # Norm preservation per rotated pair: no blowup at extreme angles.
+    pairs = np.asarray(full).reshape(2, 16, 2, 4, 2)
+    base = np.asarray(x).reshape(2, 16, 2, 4, 2)
+    np.testing.assert_allclose(
+        np.linalg.norm(pairs, axis=-1), np.linalg.norm(base, axis=-1),
+        atol=1e-5, rtol=1e-5,
+    )
+
+    # Prefill-vs-decode at the offset: teacher-forced apply_lm with
+    # pos_offset=big == prefill + decode steps whose positions override
+    # carries the same absolute positions (cache rows stay 0-based —
+    # rows and positions are decoupled exactly for this).
+    B, T, C = 1, 12, 16
+    params = _params(3)
+    tokens = jnp.asarray(
+        synthesize_copy(num_train=B, num_test=B, seq_len=T,
+                        vocab=SPEC.vocab, seed=4).tokens
+    )
+    full = transformer.apply_lm(params, tokens, SPEC,
+                                attn_fn=_oracle_attn(), pos_offset=big)
+    ck, cv, cpos = _empty_cache(B, C)
+    n = 7
+    pos = big + jnp.arange(T, dtype=jnp.int32)
+    outs = []
+    lg, ck, cv, cpos = transformer.apply_lm_cached(
+        params, tokens[:, :n], ck, cv, cpos, SPEC,
+        start=jnp.zeros((B,), jnp.int32), positions=pos[None, :n],
+    )
+    outs.append(lg)
+    for t in range(n, T):
+        lg, ck, cv, cpos = transformer.apply_lm_cached(
+            params, tokens[:, t:t + 1], ck, cv, cpos, SPEC,
+            start=jnp.full((B,), t, jnp.int32),
+            positions=pos[None, t:t + 1],
+        )
+        outs.append(lg)
+    inc = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                               atol=2e-5, rtol=1e-4)
+
+
+# -- the engine on its mesh --------------------------------------------------
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_engine_decode_parity(tp):
+    """The compiled (prefill, decode) pair reproduces full-forward
+    apply_lm logits at every position — tp=1 and tp=2 serving meshes
+    (acceptance pin). Greedy, so tokens are argmax-checkable too."""
+    C = 32
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=C,
+                                      tensor_parallel=tp))
+    params = transformer.init_lm_params(jax.random.PRNGKey(ServeConfig().seed),
+                                        SPEC)
+    prompt = synthesize_prompts(num=1, min_len=11, max_len=11,
+                                vocab=SPEC.vocab, seed=5)[0]
+    p = len(prompt)
+    tok, prefill_logits = eng.prefill(prompt, slot=1, request_id=7)
+    seq = list(prompt) + [tok]
+    logits_inc = [prefill_logits]
+    last = np.zeros(2, np.int32)
+    lengths = np.zeros(2, np.int32)
+    ids = np.zeros(2, np.int32)
+    active = np.zeros(2, bool)
+    for step in range(6):
+        last[1], lengths[1], ids[1], active[1] = seq[-1], len(seq) - 1, 7, True
+        nxt, lg = eng.decode(last, lengths, ids, active)
+        logits_inc.append(lg[1:2])
+        seq.append(int(nxt[1]))
+    inc = np.concatenate(logits_inc, axis=0)  # [p + 6, V]
+    full = transformer.apply_lm(
+        params, jnp.asarray(np.asarray(seq[:-1])[None]), SPEC,
+        attn_fn=_oracle_attn(),
+    )[0]
+    np.testing.assert_allclose(inc, np.asarray(full), atol=2e-5, rtol=1e-4)
+    # Greedy decode tokens are the full-forward argmaxes.
+    np.testing.assert_array_equal(
+        np.asarray(seq[p:]), np.argmax(np.asarray(full)[p - 1:], axis=-1)
+    )
+
+
+def test_continuous_batching_matches_isolated_decode():
+    """Acceptance pin: staggered arrivals + slot churn (5 requests over
+    2 slots) yield bit-identical tokens to each request decoded alone —
+    greedy AND seeded temperature/top-k sampling."""
+    prompts = synthesize_prompts(num=5, min_len=3, max_len=9,
+                                 vocab=SPEC.vocab, seed=6)
+    for kw in (dict(temperature=0.0),
+               dict(temperature=0.8, top_k=8, seed=11)):
+        cfg = ServeConfig(spec=SPEC, slots=2, capacity=32, **kw)
+        eng = InferenceEngine(cfg)
+        sched = Scheduler(eng)
+        reqs = [Request(id=i, prompt=p, max_new_tokens=5, arrival=i % 3)
+                for i, p in enumerate(prompts)]
+        done, stats = sched.run(reqs)
+        assert sorted(done) == list(range(5))
+        assert stats.decode_tokens > 0 and stats.latency.p99_ms > 0
+        for r in reqs:
+            eng.reset()  # same engine (no recompile), fresh cache
+            alone, _ = sched.run([Request(id=r.id, prompt=r.prompt,
+                                          max_new_tokens=5)])
+            assert alone[r.id].tokens == done[r.id].tokens, (kw, r.id)
+
+
+def test_scheduler_slot_reuse_and_validation():
+    """Slot eviction/reuse leaks nothing (more requests than slots, all
+    complete with the right lengths); bad requests are rejected up
+    front; eos stops a sequence early."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=16))
+    sched = Scheduler(eng)
+    prompts = synthesize_prompts(num=3, min_len=4, max_len=6,
+                                 vocab=SPEC.vocab, seed=7)
+    done, _ = sched.run([Request(id=i, prompt=p, max_new_tokens=4)
+                         for i, p in enumerate(prompts)])
+    assert all(len(done[i].tokens) == 4 for i in range(3))
+    with pytest.raises(ValueError, match="capacity"):
+        sched.run([Request(id=0, prompt=prompts[0], max_new_tokens=99)])
+    with pytest.raises(ValueError, match="duplicate"):
+        sched.run([Request(id=1, prompt=prompts[0], max_new_tokens=1),
+                   Request(id=1, prompt=prompts[1], max_new_tokens=1)])
+    # eos: greedy decode is deterministic — find the first greedy token
+    # and declare it eos; the run must stop at 1 generated token.
+    done, _ = sched.run([Request(id=5, prompt=prompts[0],
+                                 max_new_tokens=4)])
+    eos = done[5].tokens[0]
+    stopped, _ = Scheduler(eng, eos_id=eos).run(
+        [Request(id=6, prompt=prompts[0], max_new_tokens=4)]
+    )
+    assert stopped[6].tokens == [eos]
+
+
+def test_params_only_checkpoint_load_from_zero1_tp(tmp_path):
+    """ISSUE 2 satellite: a checkpoint written by SeqTrainer with
+    --zero1 --tensor-parallel (the hybrid optimizer's save path) loads
+    params-only into serving meshes (tp=1 AND tp=2 — re-sharding on
+    load), and the served logits match full-forward apply_lm under the
+    trained params."""
+    from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+    from ddl_tpu.utils.checkpoint import load_params
+
+    ds = synthesize_copy(num_train=32, num_test=8, seq_len=16,
+                         vocab=SPEC.vocab, seed=8)
+    ckdir = str(tmp_path / "ck")
+    SeqTrainer(
+        SeqConfig(epochs=1, batch_size=16, eval_every=0, num_workers=2,
+                  data_parallel=2, tensor_parallel=2, zero1=True,
+                  scheme="ring", spec=SPEC, seed=9),
+        ds,
+    ).train(log=lambda s: None, checkpoint_dir=ckdir)
+    path = str(tmp_path / "ck" / "ckpt.npz")
+
+    template = jax.eval_shape(
+        lambda: transformer.init_lm_params(jax.random.PRNGKey(0), SPEC)
+    )
+    host, step, _ = load_params(path, template)
+    assert step == 2  # the epoch-end save recorded its global batch
+    prompt = synthesize_prompts(num=1, min_len=8, max_len=8,
+                                vocab=SPEC.vocab, seed=10)[0]
+    full = transformer.apply_lm(host, jnp.asarray(prompt[None]), SPEC,
+                                attn_fn=_oracle_attn())[0]
+    for tp in (1, 2):
+        eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=16,
+                                          tensor_parallel=tp))
+        eng.load_params(path)
+        _, logits = eng.prefill(prompt, slot=0, request_id=0)
+        np.testing.assert_allclose(logits, np.asarray(full),
+                                   atol=2e-5, rtol=1e-4, err_msg=f"tp={tp}")
+
+    # The params-only contract: the same load works when optimizer state
+    # is ABSENT entirely (a bare params export).
+    from ddl_tpu.utils.checkpoint import save_checkpoint
+
+    bare = str(tmp_path / "params_only.npz")
+    save_checkpoint(bare, host)
+    again, _, _ = load_params(bare, template)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(again)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prompt_generator_contract():
+    """synthesize_prompts: deterministic per seed, variable lengths in
+    range, BOS-led, payload within vocab (ISSUE 2 satellite)."""
+    a = synthesize_prompts(num=12, min_len=3, max_len=20, vocab=32, seed=3)
+    b = synthesize_prompts(num=12, min_len=3, max_len=20, vocab=32, seed=3)
+    c = synthesize_prompts(num=12, min_len=3, max_len=20, vocab=32, seed=4)
+    assert len(a) == 12
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+    lens = {len(x) for x in a}
+    assert lens <= set(range(3, 21)) and len(lens) > 1
+    for x in a:
+        assert x.dtype == np.int32 and x[0] == 0
+        assert (x[1:] >= 1).all() and (x[1:] < 32).all()
+    with pytest.raises(ValueError, match="min_len"):
+        synthesize_prompts(min_len=5, max_len=4)
+
+
+# -- long sweeps (excluded from tier-1 via -m 'not slow') --------------------
+
+
+@pytest.mark.slow
+def test_continuous_batching_sweep_slow():
+    """The wide grid: arrival patterns x sampling configs x slot widths,
+    all pinned against isolated decode — the exhaustive version of the
+    fast smoke above."""
+    prompts = synthesize_prompts(num=8, min_len=3, max_len=14,
+                                 vocab=SPEC.vocab, seed=12)
+    for slots in (2, 3):
+        for kw in (dict(temperature=0.0), dict(temperature=1.2, seed=5),
+                   dict(temperature=0.6, top_k=4, seed=6)):
+            eng = InferenceEngine(
+                ServeConfig(spec=SPEC, slots=slots, capacity=64, **kw)
+            )
+            sched = Scheduler(eng)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=3 + i % 5,
+                            arrival=(i * 2) % 5)
+                    for i, p in enumerate(prompts)]
+            done, _ = sched.run(reqs)
+            for r in reqs:
+                eng.reset()
+                alone, _ = sched.run([Request(
+                    id=r.id, prompt=r.prompt,
+                    max_new_tokens=r.max_new_tokens,
+                )])
+                assert alone[r.id].tokens == done[r.id].tokens, (
+                    slots, kw, r.id
+                )
+
+
+@pytest.mark.slow
+def test_engine_tp2_long_generation_slow():
+    """tp=2 decode far past the prompt (40 steps, capacity 64): logits
+    stay pinned to full-forward at every generated position."""
+    C = 64
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=C,
+                                      tensor_parallel=2))
+    params = transformer.init_lm_params(
+        jax.random.PRNGKey(ServeConfig().seed), SPEC
+    )
+    prompt = synthesize_prompts(num=1, min_len=6, max_len=6,
+                                vocab=SPEC.vocab, seed=13)[0]
+    tok, _ = eng.prefill(prompt, slot=0, request_id=1)
+    seq = list(prompt) + [tok]
+    for _ in range(40):
+        nxt, _ = eng.decode(
+            np.asarray([seq[-1]], np.int32),
+            np.asarray([len(seq) - 1], np.int32),
+            np.asarray([1], np.int32), np.asarray([True]),
+        )
+        seq.append(int(nxt[0]))
+    full = transformer.apply_lm(
+        params, jnp.asarray(np.asarray(seq[:-1])[None]), SPEC,
+        attn_fn=_oracle_attn(),
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(seq[len(prompt):]),
+        np.argmax(np.asarray(full)[len(prompt) - 1:], axis=-1),
+    )
