@@ -123,7 +123,8 @@ def _opt_bytes_per_device(opt_state) -> int:
     )
 
 
-def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
+def audit_lm(mode: str, dp: int, sp: int, tp: int = 1, pp: int = 1,
+             microbatches: int = 2) -> dict:
     """Collective schedule of the LM train step (strategies/seq.py) on a
     ``[dp, sp(, tp)]`` mesh: ``replicated`` should show the grad
     all-reduce (plus the ring's collective-permutes); ``zero1`` should
@@ -138,6 +139,14 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     chunks (``rep_total`` in the row), per-tp-shard weight-grad
     all-reduces over (dp, sp), and the Megatron activation psums.
 
+    ``pp > 1`` is the PIPELINE row (``mode="pipeline"``, sp forced to 1,
+    scheme full): the schedule should show ``collective-permute``s of
+    ACTIVATION size — ``2 * ticks`` of them, one forward activation hop
+    and one backward cotangent hop per schedule tick, each
+    ``[B/(dp*M), T, E]`` — plus the shared-leaf (embed/head/final-LN)
+    grad psums over (dp, sp, pp); the stage-resident block grads must
+    never cross the pp axis.
+
     Every row also carries ``opt_state_bytes_per_device`` — the measured
     optimizer-state residency behind the memory-law table
     (BASELINE.md)."""
@@ -147,13 +156,16 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     from ddl_tpu.models.transformer import TINY_SPEC
     from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
 
-    nseq = 2 * dp
+    nseq = max(2, 2 * microbatches) * dp if pp > 1 else 2 * dp
     ds = synthesize_copy(num_train=nseq, num_test=nseq, seq_len=8 * sp,
                          vocab=TINY_SPEC.vocab, seed=0)
     tr = SeqTrainer(
-        SeqConfig(num_workers=sp, data_parallel=dp, scheme="ring",
+        SeqConfig(num_workers=sp, data_parallel=dp,
+                  scheme="full" if pp > 1 else "ring",
                   zero1=(mode == "zero1"), batch_size=nseq,
-                  tensor_parallel=tp, spec=TINY_SPEC),
+                  tensor_parallel=tp, pipeline_parallel=pp,
+                  microbatches=microbatches if pp > 1 else 1,
+                  spec=TINY_SPEC),
         ds,
     )
     xs = tr._stage(ds.tokens, 1, nseq)
@@ -165,13 +177,21 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1) -> dict:
     ops = collective_ops(txt)
     row = {
         "mode": mode,
-        "mesh": f"{dp}x{sp}" + (f"x{tp}" if tp > 1 else ""),
+        "mesh": (f"{dp}x{sp}x{tp}x{pp}" if pp > 1
+                 else f"{dp}x{sp}" + (f"x{tp}" if tp > 1 else "")),
         "total_params": tr._plan.total,
         "opt_state_bytes_per_device": _opt_bytes_per_device(tr.opt_state),
         "collectives": ops,
         "reduce_bytes": sum(o["bytes"] for o in ops
                             if o["op"] in ("all-reduce", "reduce-scatter")),
     }
+    if pp > 1:
+        from ddl_tpu.pipeline.schedule import predicted_bubble
+
+        row["microbatches"] = microbatches
+        row["permute_bytes"] = sum(o["bytes"] for o in ops
+                                   if o["op"] == "collective-permute")
+        row["predicted_bubble"] = predicted_bubble(pp, microbatches)
     if tr._hplan is not None:
         row["rep_total"] = tr._hplan.rep_total
     return row
@@ -206,6 +226,14 @@ def main() -> int:
         audit_lm("zero1", 2, half),
         audit_lm("replicated", 1, half, tp=2),
     ]
+    if args.devices >= 2:
+        # The pipeline row: activation-sized collective-permutes (one
+        # fwd + one bwd hop per schedule tick), stage-local block grads.
+        lm_rows.append(audit_lm("pipeline", 1, 1, pp=2, microbatches=4))
+    if args.devices >= 4:
+        lm_rows.append(
+            audit_lm("pipeline", 2, 1, pp=2, microbatches=4)
+        )
     if args.devices >= 8:
         # The zero1 x tp tentpole pair on the SAME 2x2x2 cube: identical
         # mesh, identical model — the only delta is the hybrid sharded
@@ -217,6 +245,10 @@ def main() -> int:
               f"reduce_bytes={r['reduce_bytes']} "
               f"opt_bytes/dev={r['opt_state_bytes_per_device']}",
               file=sys.stderr)
+        if "permute_bytes" in r:
+            print(f"    pp activation-permute bytes={r['permute_bytes']} "
+                  f"(M={r['microbatches']}, predicted bubble "
+                  f"{r['predicted_bubble']:.3f})", file=sys.stderr)
         for o in r["collectives"]:
             print(f"    {o['op']:<18} {o['dtype']}{o['shape']} "
                   f"= {o['bytes']} B", file=sys.stderr)

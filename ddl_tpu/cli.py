@@ -207,6 +207,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "sequence shards over --num-workers columns "
                          "(total devices = DP * num-workers); --batch-size "
                          "must divide by DP")
+    lm.add_argument("--pipeline-parallel", type=int, default=1,
+                    metavar="PP",
+                    help="pipeline parallelism (ddl_tpu.pipeline): split "
+                         "the layer stack into PP contiguous stages over "
+                         "the pp mesh axis (minor — stage-hop ppermutes "
+                         "ride neighbouring ICI links); needs --layers "
+                         "divisible by PP, --microbatches >= 2, "
+                         "--num-workers 1 --seq-scheme full; composes "
+                         "with --data-parallel and --tensor-parallel on "
+                         "the 4-D [dp, 1, tp, pp] mesh (NOT with --zero1 "
+                         "or sequence parallelism — see the README "
+                         "composition matrix)")
+    lm.add_argument("--microbatches", type=int, default=1, metavar="M",
+                    help="microbatches streamed through the pipeline per "
+                         "step (gradient-accumulated; bubble fraction = "
+                         "(PP-1)/(M+PP-1)); must divide the per-dp-row "
+                         "batch; requires --pipeline-parallel > 1")
+    lm.add_argument("--pipeline-schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="microbatch schedule: gpipe (flush — all "
+                         "forwards, then all backwards; M in-flight "
+                         "activations per stage) or 1f1b (steady-state "
+                         "one-forward-one-backward; min(PP, M) in-flight "
+                         "— same bubble, less memory)")
     lm.add_argument("--zero1", action="store_true",
                     help="ZeRO-1 over the combined (dp, sp) mesh axes: "
                          "reduce-scatter grads, Adam on each device's "
@@ -465,6 +489,7 @@ _MNIST_ONLY_DESTS = (
 _TRAIN_ONLY_DESTS = (
     "seq_scheme", "seq_len", "train_seqs", "test_seqs", "target_accuracy",
     "attn_impl", "remat", "seq_layout", "data_parallel", "zero1",
+    "pipeline_parallel", "microbatches", "pipeline_schedule",
     "num_workers", "epochs", "batch_size", "lr", "eval_every",
     "checkpoint_every", "resume", "dispatch_timeout", "profile",
 )
@@ -502,8 +527,16 @@ def _run_lm(args) -> int:
         raise SystemExit(
             f"--tensor-parallel must be >= 1, got {args.tensor_parallel}"
         )
+    if args.pipeline_parallel < 1:
+        raise SystemExit(
+            f"--pipeline-parallel must be >= 1, got {args.pipeline_parallel}"
+        )
     if args.num_workers:
         num_workers = args.num_workers
+    elif args.pipeline_parallel > 1:
+        # Pipeline topologies have no sequence axis (validate_topology
+        # requires num_workers == 1) — never default it to spare devices.
+        num_workers = 1
     else:
         # Default: all devices, split between the dp rows and tp columns.
         num_workers = max(
@@ -511,7 +544,8 @@ def _run_lm(args) -> int:
             _default_workers(args.variant)
             // (args.data_parallel * args.tensor_parallel),
         )
-    n_dev = num_workers * args.data_parallel * args.tensor_parallel
+    n_dev = (num_workers * args.data_parallel * args.tensor_parallel
+             * args.pipeline_parallel)
     if args.multihost:
         _ensure_devices(n_dev, allow_fallback=False,
                         reason="use --num-workers * --data-parallel * "
@@ -524,6 +558,15 @@ def _run_lm(args) -> int:
     spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
                   num_heads=args.heads, num_layers=args.layers,
                   d_ff=args.d_ff)
+    scheme = args.seq_scheme
+    if args.pipeline_parallel > 1 and scheme == "ring":
+        # Mirror the num_workers=1 defaulting above: pipeline stages
+        # hold the WHOLE sequence, so the parser's ring default maps to
+        # the stage-local full-sequence kernel — loudly, never silently
+        # (an explicit --seq-scheme ulysses still fails validation).
+        print("[ddl_tpu] --pipeline-parallel: sequence is whole per "
+              "stage; using --seq-scheme full")
+        scheme = "full"
     cfg = SeqConfig(
         epochs=args.epochs,
         batch_size=args.batch_size or 32,
@@ -533,13 +576,16 @@ def _run_lm(args) -> int:
         num_workers=num_workers,
         data_parallel=args.data_parallel,
         tensor_parallel=args.tensor_parallel,
-        scheme=args.seq_scheme,
+        scheme=scheme,
         compute_dtype=_resolve_dtype(args),
         target_accuracy=args.target_accuracy,
         zero1=args.zero1,
         attn_impl=args.attn_impl,
         remat=args.remat,
         seq_layout=args.seq_layout,
+        pipeline_parallel=args.pipeline_parallel,
+        microbatches=args.microbatches,
+        pipeline_schedule=args.pipeline_schedule,
         spec=spec,
     )
     from .parallel.mesh import AcceleratorTimeout
@@ -726,21 +772,33 @@ def main(argv: list[str] | None = None) -> int:
                 # blanket 8 per process would put the whole mesh on
                 # process 0 and leave the others owning no rows
                 # (make_mesh rejects that).
-                total = ((args.num_workers or args.num_processes)
-                         * args.data_parallel * args.tensor_parallel)
+                # Mirror _run_lm's num_workers defaulting (1 under
+                # pipeline parallelism — no sequence axis) so this
+                # world-size computation and the mesh it later builds
+                # can never disagree.
+                total = ((args.num_workers
+                          or (1 if args.pipeline_parallel > 1
+                              else args.num_processes))
+                         * args.data_parallel * args.tensor_parallel
+                         * args.pipeline_parallel)
                 if total % args.num_processes:
                     raise SystemExit(
                         f"total devices {total} (num-workers x "
-                        f"data-parallel x tensor-parallel) is not "
-                        f"divisible by --num-processes {args.num_processes}"
+                        f"data-parallel x tensor-parallel x "
+                        f"pipeline-parallel) is not divisible by "
+                        f"--num-processes {args.num_processes}"
                     )
                 n_local = total // args.num_processes
             else:
                 # lm 2-D/3-D topologies need num_workers * data_parallel
                 # * tensor_parallel devices (both default to 1 elsewhere).
+                # Pipeline topologies default num_workers to 1 (no
+                # sequence axis) — mirror _run_lm's defaulting here so
+                # the virtual device count matches the mesh it builds.
+                default_w = 1 if args.pipeline_parallel > 1 else 8
                 n_local = max(
-                    (args.num_workers or 8) * args.data_parallel
-                    * args.tensor_parallel,
+                    (args.num_workers or default_w) * args.data_parallel
+                    * args.tensor_parallel * args.pipeline_parallel,
                     8,
                 )
             from .parallel.mesh import set_cpu_device_count

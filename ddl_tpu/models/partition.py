@@ -12,9 +12,12 @@ silently-wrong decode logits, not an error).
 
 from __future__ import annotations
 
+import dataclasses
+
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import TP_AXIS
+from ..parallel.mesh import PP_AXIS, TP_AXIS
 from .transformer import LMSpec
 
 
@@ -37,3 +40,112 @@ def lm_param_specs(spec: LMSpec, tensor_parallel: int):
         "blocks": [dict(blk) for _ in range(spec.num_layers)],
         "lnf_g": P(), "lnf_b": P(), "head": P(),
     }
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism: contiguous layer stages over PP_AXIS
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePartition:
+    """Contiguous split of the ``blocks`` list into ``pp`` pipeline
+    stages. Stage ``s`` owns layers ``[s * L/pp, (s+1) * L/pp)``; the
+    embedding belongs with stage 0 (it produces the pipeline's first
+    activation) and the final LayerNorm + head with the LAST stage (they
+    consume its last activation) — but those three leaves stay
+    pp-REPLICATED in the placed tree: they are small next to the block
+    stack, and replication lets every pp position run one uniform SPMD
+    program (the non-owning stages' uses are masked, their gradients
+    exactly zero, and one psum over pp broadcasts the owner's grads).
+
+    The placed form stacks the per-layer block dicts into ONE pytree of
+    ``[num_layers, ...]`` leaves sharded ``P(PP_AXIS, ...)`` — each pp
+    position's addressable shard is exactly its stage's layers, and the
+    stage boundary is the shard boundary (no layer ever straddles two
+    stages by construction of the divisibility check)."""
+
+    num_layers: int
+    pp: int
+
+    def __post_init__(self):
+        if self.pp < 1:
+            raise ValueError(f"pp must be >= 1, got {self.pp}")
+        if self.num_layers % self.pp:
+            raise ValueError(
+                f"pipeline_parallel ({self.pp}) must divide num_layers "
+                f"({self.num_layers}) — stages are contiguous equal "
+                "layer blocks"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.num_layers // self.pp
+
+    def stage_layers(self, s: int) -> range:
+        """The layer indices stage ``s`` owns."""
+        return range(s * self.layers_per_stage,
+                     (s + 1) * self.layers_per_stage)
+
+
+def stage_partition(spec: LMSpec, pp: int) -> StagePartition:
+    """The contiguous stage split for this model at pipeline degree
+    ``pp`` (embed with stage 0, final-LN/head with the last stage — see
+    :class:`StagePartition`)."""
+    return StagePartition(num_layers=spec.num_layers, pp=pp)
+
+
+def pipeline_param_specs(spec: LMSpec, pp: int, tensor_parallel: int = 1):
+    """PartitionSpec tree for the PIPELINE (stacked-blocks) param form:
+    every block leaf gains a leading ``[num_layers]`` dim sharded over
+    ``PP_AXIS`` (its trailing dims keep the Megatron column/row
+    assignment of :func:`lm_param_specs` when ``tensor_parallel > 1``);
+    embed/head/final-LN stay replicated — the same leaves that are
+    tp-replicated, for the same reason (they touch the full-width
+    stream/vocab, and their owners' grads psum-broadcast over pp)."""
+    stage_partition(spec, pp)  # validate divisibility loudly
+    col, row = P(PP_AXIS, None, TP_AXIS), P(PP_AXIS, TP_AXIS, None)
+    if tensor_parallel == 1:
+        col = row = P(PP_AXIS)
+    vec = P(PP_AXIS)
+    blk = {"ln1_g": vec, "ln1_b": vec, "wq": col, "wk": col, "wv": col,
+           "wo": row, "ln2_g": vec, "ln2_b": vec,
+           "w1": col,
+           "b1": P(PP_AXIS, TP_AXIS) if tensor_parallel > 1 else vec,
+           "w2": row, "b2": vec}
+    return {
+        "embed": P(),
+        "blocks": blk,
+        "lnf_g": P(), "lnf_b": P(), "head": P(),
+    }
+
+
+def stack_blocks(params):
+    """Standard param tree (``blocks`` = list of per-layer dicts) ->
+    pipeline form (``blocks`` = ONE dict of ``[num_layers, ...]``-stacked
+    leaves). Host-side (np.stack); the inverse is
+    :func:`unstack_blocks`. Checkpoints always store the STANDARD form,
+    so a pipeline save restores into a non-pp world and vice versa —
+    the same layout-free contract every other topology keeps."""
+    blocks = params["blocks"]
+    stacked = {
+        k: np.stack([np.asarray(b[k]) for b in blocks])
+        for k in blocks[0]
+    }
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = stacked
+    return out
+
+
+def unstack_blocks(params):
+    """Inverse of :func:`stack_blocks`: pipeline (stacked) form back to
+    the standard per-layer-dict list, leaf order preserved."""
+    stacked = params["blocks"]
+    num_layers = next(iter(stacked.values())).shape[0]
+    blocks = [
+        {k: np.asarray(v[i]) for k, v in stacked.items()}
+        for i in range(num_layers)
+    ]
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = blocks
+    return out

@@ -139,6 +139,40 @@ def rope(x: jax.Array, positions: jax.Array, base: float) -> jax.Array:
     return out.reshape(x.shape)
 
 
+def apply_block(
+    h: jax.Array,
+    blk: Params,
+    spec: LMSpec,
+    *,
+    attn_fn: AttnFn,
+    positions: jax.Array,
+    row_reduce=None,
+    col_promote=None,
+) -> jax.Array:
+    """ONE pre-LN transformer block on residual stream ``h [B, T, E]`` —
+    the layer unit both :func:`apply_lm` (whole stack, one device or
+    sequence/tensor shards) and the pipeline stages (``ddl_tpu.pipeline``:
+    a contiguous subset of layers per pp mesh position) apply, so a
+    pipelined model can never drift from the oracle's per-layer math.
+    The local head count is inferred from the (possibly tp-column-
+    sharded) ``wq`` width; ``row_reduce``/``col_promote`` are Megatron's
+    g/f hooks (see :func:`apply_lm`)."""
+    b, t, _ = h.shape
+    heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
+    reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
+    promote = col_promote if col_promote is not None else (lambda x: x)
+    x = promote(_layernorm(h, blk["ln1_g"], blk["ln1_b"]))
+    q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
+    k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
+    v = heads(x @ blk["wv"])
+    a = attn_fn(q, k, v)
+    h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
+    x = promote(_layernorm(h, blk["ln2_g"], blk["ln2_b"]))
+    return h + reduce_(
+        jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
+    ) + blk["b2"]
+
+
 def apply_lm(
     params: Params,
     tokens: jax.Array,
@@ -197,26 +231,15 @@ def apply_lm(
     if compute_dtype is not None:
         params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
     h = params["embed"][tokens]  # [B, T, E]
-    b, t, e = h.shape
+    _, t, _ = h.shape
     if positions is None:
         positions = pos_offset + jnp.arange(t)
-    # Local head count from the (possibly tp-column-sharded) wq width —
-    # the same code runs full-width and tensor-parallel.
-    heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
-    reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
-    promote = col_promote if col_promote is not None else (lambda x: x)
 
     def block(h, blk):
-        x = promote(_layernorm(h, blk["ln1_g"], blk["ln1_b"]))
-        q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
-        k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
-        v = heads(x @ blk["wv"])
-        a = attn_fn(q, k, v)
-        h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
-        x = promote(_layernorm(h, blk["ln2_g"], blk["ln2_b"]))
-        return h + reduce_(
-            jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
-        ) + blk["b2"]
+        return apply_block(
+            h, blk, spec, attn_fn=attn_fn, positions=positions,
+            row_reduce=row_reduce, col_promote=col_promote,
+        )
 
     if remat:
         block = jax.checkpoint(block)
@@ -307,6 +330,20 @@ def apply_lm_cached(
     return logits, cache_k, cache_v, cache_pos
 
 
+def ce_sums(
+    logits: jax.Array, targets: jax.Array, weights: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Weighted cross-entropy of fp32 ``logits [B, T, V]`` against
+    ``targets [B, T]`` as ``(sum_ce, sum_weights)`` — the accumulator
+    form behind :func:`lm_loss_sums`, exposed so the pipeline's last
+    stage (which holds logits but not the whole model) scores with
+    EXACTLY the oracle's loss math."""
+    logprobs = jax.nn.log_softmax(logits)
+    ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    return jnp.sum(ce * w), jnp.sum(w)
+
+
 def lm_loss_sums(
     params: Params,
     tokens: jax.Array,
@@ -333,10 +370,7 @@ def lm_loss_sums(
         positions=positions, compute_dtype=compute_dtype, remat=remat,
         row_reduce=row_reduce, col_promote=col_promote,
     )
-    logprobs = jax.nn.log_softmax(logits)
-    ce = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    w = weights.astype(jnp.float32)
-    return jnp.sum(ce * w), jnp.sum(w)
+    return ce_sums(logits, targets, weights)
 
 
 def lm_correct_sums(

@@ -98,6 +98,33 @@ def make_mesh_3d(
     return _mesh_nd((num_dp, num_sp, num_tp), axes, devices)
 
 
+# Pipeline-parallel axis: the LAYER STACK splits into contiguous stages
+# over it (ddl_tpu.pipeline). Activations (and cotangents on the
+# backward) hop stage-to-stage via lax.ppermute each schedule tick.
+PP_AXIS = "pp"
+
+
+def make_mesh_4d(
+    num_dp: int,
+    num_sp: int,
+    num_tp: int,
+    num_pp: int,
+    *,
+    axes: tuple[str, str, str, str] = (DP_AXIS, SP_AXIS, TP_AXIS, PP_AXIS),
+    devices=None,
+) -> Mesh:
+    """A ``[num_dp, num_sp, num_tp, num_pp]`` mesh over the first
+    ``dp*sp*tp*pp`` devices. The MINOR (pp) axis is contiguous in
+    ``jax.devices()`` order, so every stage hop — one activation
+    ppermute forward and one cotangent ppermute backward per schedule
+    tick — rides a neighbouring ICI link; tp psums stride by ``num_pp``
+    (still short hops within a slice), sp and dp stride wider. A
+    ``num_pp == 1`` topology should use :func:`make_mesh_3d` /
+    :func:`make_mesh_2d` instead (byte-identical programs to the
+    pre-pipeline stack)."""
+    return _mesh_nd((num_dp, num_sp, num_tp, num_pp), axes, devices)
+
+
 def _mesh_nd(shape: tuple[int, ...], axes: tuple[str, ...], devices) -> Mesh:
     """Shared builder behind the 2-D/3-D mesh constructors: validates
     sizes, slices the leading devices, and rejects topologies that leave

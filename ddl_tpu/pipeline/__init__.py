@@ -1,0 +1,33 @@
+"""Pipeline parallelism: the layer stack split into contiguous stages
+over the ``pp`` mesh axis (``parallel.mesh.PP_AXIS``).
+
+The source paper shards one model's PARAMETERS across processes (PS
+sharding); this package adds the classic axis that keeps per-device
+memory flat as DEPTH grows (arXiv:2412.14374, arXiv:2204.06514):
+stage ``s`` holds layers ``[s*L/pp, (s+1)*L/pp)``, microbatches stream
+through the stages, and activations (cotangents on the backward) hop
+stage-to-stage via ``lax.ppermute`` over neighbouring ICI links.
+
+- ``schedule``: GPipe / 1F1B microbatch tick tables, the in-flight
+  activation-buffer sizes they imply, and the analytic bubble model
+  (``(pp-1)/(microbatches+pp-1)``) that ``benchmarks/pipeline_bubble.py``
+  falsifies against measured step time.
+- ``step``: the ``shard_map`` train-step body — one ``lax.scan`` over
+  schedule ticks executing both schedules from their tables, with a
+  MANUAL per-microbatch backward (``jax.vjp`` recompute from saved
+  stage inputs, never a bare psum/ppermute transpose — the repo's
+  explicit-gradient discipline, parallel/collectives.py).
+- ``trainer``: program builders wiring the step into ``SeqTrainer``
+  (``SeqConfig.pipeline_parallel`` / ``microbatches``) and into the
+  benchmarks.
+"""
+
+from .schedule import (  # noqa: F401
+    IDLE,
+    buffer_slots,
+    bubble_fraction,
+    max_in_flight,
+    predicted_bubble,
+    schedule_tables,
+)
+from .trainer import make_pipeline_program  # noqa: F401

@@ -1,0 +1,111 @@
+"""Pipeline program builders — the wiring layer between the step bodies
+(``pipeline.step``) and their three consumers: ``SeqTrainer``'s span
+machinery (``SeqConfig.pipeline_parallel`` / ``microbatches``), the
+bubble benchmark (``benchmarks/pipeline_bubble.py`` — which sweeps
+``microbatches=1`` rows the trainer's topology validation deliberately
+rejects), and the collective-bytes audit. One builder each, so every
+consumer compiles the SAME program."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models import transformer
+from ..models.partition import (
+    pipeline_param_specs,
+    stack_blocks,
+    stage_partition,
+)
+from ..ops import adam_init
+from ..ops.optimizers import AdamState
+from ..parallel import multihost
+from ..parallel.mesh import (
+    DP_AXIS,
+    SP_AXIS,
+    donation_for,
+    make_mesh_4d,
+)
+from .schedule import schedule_tables
+from .step import make_pipeline_eval_body, make_pipeline_step_body
+
+
+def pipeline_shard_step(config, mesh, platform):
+    """The ``shard_map``'d pipeline train step for this config on this
+    4-D mesh: ``(params, opt, tokens, targets, weights) ->
+    (params, opt, loss)`` with train batches ``P(dp, sp)`` (sp is size
+    1), the stacked param tree ``P(pp, ...)``-sharded, and optimizer
+    state placed like the params. ``check_vma=False`` — local-grads
+    mode, every reduction explicit in the body (pipeline.step)."""
+    part = stage_partition(config.spec, config.pipeline_parallel)
+    tables = schedule_tables(
+        config.pipeline_schedule, part.pp, config.microbatches
+    )
+    body = make_pipeline_step_body(
+        config, part, tables, platform, lr=config.learning_rate
+    )
+    pspecs = pipeline_param_specs(
+        config.spec, part.pp, config.tensor_parallel
+    )
+    opt_spec = AdamState(step=P(), m=pspecs, v=pspecs)
+    seq = P(DP_AXIS, SP_AXIS)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, opt_spec, seq, seq, seq),
+        out_specs=(pspecs, opt_spec, P()),
+        check_vma=False,
+    )
+
+
+def pipeline_shard_eval(config, mesh, platform, data_spec):
+    """The ``shard_map``'d forward-only eval: ``(params, tokens,
+    targets, weights) -> (num, den)`` hit sums, test data dp-replicated
+    (``data_spec`` is the trainer's ``_seq_spec``)."""
+    part = stage_partition(config.spec, config.pipeline_parallel)
+    body = make_pipeline_eval_body(config, part, platform)
+    pspecs = pipeline_param_specs(
+        config.spec, part.pp, config.tensor_parallel
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec, data_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+
+def make_pipeline_program(config, tokens, targets, weights):
+    """Standalone compiled pipeline step on a FRESH ``dp x 1 x tp x pp``
+    mesh — the benchmark/audit entry point (bypasses SeqTrainer, so a
+    ``microbatches=1`` config — rejected by ``validate_topology`` for
+    training — can still be measured as the zero-pipelining bubble
+    anchor). Returns ``(fn, (params, opt, xs, ys, ws))``: placed state
+    plus the jitted step; callers time ``fn(*state)`` with a host-fetch
+    barrier on the loss."""
+    mesh = make_mesh_4d(
+        config.data_parallel, config.num_workers,
+        config.tensor_parallel, config.pipeline_parallel,
+    )
+    platform = mesh.devices.flat[0].platform
+    shard_step = pipeline_shard_step(config, mesh, platform)
+    host = jax.tree.map(
+        np.asarray,
+        transformer.init_lm_params(
+            jax.random.PRNGKey(config.seed), config.spec
+        ),
+    )
+    stacked = stack_blocks(host)
+    pspecs = pipeline_param_specs(
+        config.spec, config.pipeline_parallel, config.tensor_parallel
+    )
+    opt_spec = AdamState(step=P(), m=pspecs, v=pspecs)
+    params = multihost.put_tree(mesh, pspecs, stacked)
+    opt = multihost.put_tree(mesh, opt_spec, adam_init(stacked))
+    seq = P(DP_AXIS, SP_AXIS)
+    put = lambda a: multihost.put(mesh, seq, np.asarray(a))
+    state = (params, opt, put(tokens), put(targets), put(weights))
+    fn = jax.jit(shard_step, donate_argnums=donation_for(mesh, 0, 1))
+    return fn, state

@@ -107,12 +107,26 @@ class Scheduler:
         self.engine.reset()
 
     def _validate(self, r: Request) -> None:
+        """Reject a malformed request at SUBMIT time — ``run`` validates
+        every request before admitting ANY, so one oversized prompt in a
+        batch of valid ones fails the whole call with a per-request
+        diagnosis and no partial state (no slot prefilled, no cache rows
+        written) instead of letting ``engine.prefill_bucket`` raise
+        mid-run after other slots were already admitted."""
         cap = self.engine.config.capacity
         p = int(np.asarray(r.prompt).shape[0])
         if p < 1:
             raise ValueError(f"request {r.id}: empty prompt")
         if r.max_new_tokens < 1:
             raise ValueError(f"request {r.id}: max_new_tokens must be >= 1")
+        if p > cap:
+            # Named separately from the combined budget below: the fix
+            # is a bigger --capacity (or a shorter prompt), not a
+            # smaller max_new_tokens.
+            raise ValueError(
+                f"request {r.id}: prompt length {p} exceeds cache "
+                f"capacity {cap}"
+            )
         if p + r.max_new_tokens > cap:
             raise ValueError(
                 f"request {r.id}: prompt ({p}) + max_new_tokens "

@@ -85,6 +85,7 @@ from ..parallel.mesh import (
     donation_for,
     make_mesh_2d,
     make_mesh_3d,
+    make_mesh_4d,
 )
 from .sync import ShardedAdam, _adam_flat
 from ..train.trainer import (
@@ -168,10 +169,85 @@ class SeqConfig:
     # gather (ring.zigzag_permutation); RoPE gets the matching absolute
     # positions, so training is numerically the same computation.
     seq_layout: Literal["contiguous", "zigzag"] = "contiguous"
+    # Pipeline parallelism (ddl_tpu.pipeline): the LAYER STACK splits
+    # into pipeline_parallel contiguous stages over the pp mesh axis
+    # (minor — stage-hop ppermutes ride neighbouring ICI links); the
+    # global batch splits into `microbatches` that stream through the
+    # stages per `pipeline_schedule` (gpipe = flush; 1f1b = steady-state
+    # interleave with min(pp, M) instead of M in-flight activations per
+    # stage). Composes with data_parallel and tensor_parallel on the
+    # 4-D [dp, 1, tp, pp] mesh; sequence parallelism and zero1 are
+    # rejected with pipeline_parallel > 1 (validate_topology; README
+    # composition matrix).
+    pipeline_parallel: int = 1
+    microbatches: int = 1
+    pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
     spec: LMSpec = LMSpec()
 
     def dtype(self):
         return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
+
+    def validate_topology(self) -> None:
+        """Fail-fast pipeline topology validation (one place, unit-
+        tested): SeqTrainer calls this before ANY device work, so a
+        misconfiguration is a clean ValueError with the fix, never a
+        shape error deep inside shard_map. Benchmarks that measure the
+        step machinery directly (pipeline_bubble's microbatches=1
+        zero-pipelining anchor) construct configs without it."""
+        pp = self.pipeline_parallel
+        m = self.microbatches
+        if pp < 1:
+            raise ValueError(f"pipeline_parallel must be >= 1, got {pp}")
+        if m < 1:
+            raise ValueError(f"microbatches must be >= 1, got {m}")
+        if m > 1 and pp == 1:
+            raise ValueError(
+                f"microbatches ({m}) > 1 requires pipeline_parallel > 1 "
+                "(microbatching exists to fill the pipeline; without "
+                "stages it only re-associates the batch)"
+            )
+        if pp == 1:
+            return
+        if self.spec.num_layers % pp:
+            raise ValueError(
+                f"pipeline_parallel ({pp}) must divide num_layers "
+                f"({self.spec.num_layers}) — stages are contiguous "
+                "equal layer blocks"
+            )
+        if m < 2:
+            raise ValueError(
+                f"pipeline_parallel ({pp}) > 1 requires microbatches > 1 "
+                f"— one microbatch leaves (pp-1)/pp = {pp - 1}/{pp} of "
+                "every step idle (the GPipe bubble); pass "
+                "--microbatches >= 2"
+            )
+        if self.batch_size % (self.data_parallel * m):
+            raise ValueError(
+                f"microbatches ({m}) x data_parallel "
+                f"({self.data_parallel}) must divide the global batch "
+                f"({self.batch_size}) — each dp row streams equal "
+                "microbatches through the stages"
+            )
+        if self.num_workers != 1 or self.scheme != "full":
+            raise ValueError(
+                "pipeline_parallel composes with data/tensor parallelism "
+                "only: use num_workers=1 and scheme='full' (sequence x "
+                "pipeline is rejected — README composition matrix)"
+            )
+        if self.zero1:
+            raise ValueError(
+                "zero1 x pipeline_parallel is not supported: the "
+                "pipeline Adam path keeps stage-local optimizer state "
+                "(already sharded pp-fold with the layers); see the "
+                "README composition matrix"
+            )
+        from ..pipeline.schedule import SCHEDULES
+
+        if self.pipeline_schedule not in SCHEDULES:
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r} "
+                f"(choices: {', '.join(SCHEDULES)})"
+            )
 
 
 @dataclasses.dataclass
@@ -580,6 +656,11 @@ class SeqTrainer:
         W = config.num_workers
         dp = config.data_parallel
         tp = config.tensor_parallel
+        ppl = config.pipeline_parallel
+        # Pipeline topology rules first (pp | num_layers, microbatch
+        # divisibility, the rejected compositions) — one unit-tested
+        # gate on SeqConfig, shared with the CLI.
+        config.validate_topology()
         if dataset.seq_len % max(W, 1):
             raise ValueError(
                 f"seq_len {dataset.seq_len} not divisible by {W} workers"
@@ -641,17 +722,30 @@ class SeqTrainer:
         _attn_for(config)  # fail fast: unknown scheme / full-with-sharding
         self.config = config
         self.dataset = dataset
-        # tp=1 keeps the 2-D mesh (and therefore every pre-tp program
-        # byte for byte); tp>1 adds the minor tp axis.
+        # pp=1, tp=1 keeps the 2-D mesh (and therefore every pre-tp
+        # program byte for byte); tp>1 adds the minor tp axis; pp>1 the
+        # 4-D mesh with pp minor (stage hops on neighbouring ICI links).
         self.mesh = (
-            make_mesh_3d(dp, W, tp) if tp > 1 else make_mesh_2d(dp, W)
+            make_mesh_4d(dp, W, tp, ppl) if ppl > 1
+            else make_mesh_3d(dp, W, tp) if tp > 1
+            else make_mesh_2d(dp, W)
         )
-        self._pspecs = _param_specs(config)
+        from ..models import partition as partition_mod
+
+        self._partition = partition_mod
+        self._part = (
+            partition_mod.stage_partition(config.spec, ppl)
+            if ppl > 1 else None
+        )
+        self._pspecs = (
+            partition_mod.pipeline_param_specs(config.spec, ppl, tp)
+            if ppl > 1 else _param_specs(config)
+        )
         # Optimizer placement mirrors the params (m/v are params-shaped);
         # a single P() keeps put_tree's broadcast form at tp=1.
         self._opt_specs = (
             AdamState(step=P(), m=self._pspecs, v=self._pspecs)
-            if tp > 1 else P()
+            if tp > 1 or ppl > 1 else P()
         )
         # Kernel selection (flash vs reference twin) follows where the
         # program actually runs, not the default backend (round-4 advisor).
@@ -672,7 +766,21 @@ class SeqTrainer:
         host_init = transformer.init_lm_params(
             jax.random.PRNGKey(config.seed), config.spec
         )
-        self.params = multihost.put_tree(self.mesh, self._pspecs, host_init)
+        # Standard params-shaped template (shapes only) — the checkpoint
+        # form every mode reads/writes, including pipeline runs whose
+        # LIVE params are the stacked-blocks tree.
+        self._host_like = jax.eval_shape(lambda: host_init)
+        if ppl > 1:
+            self.params = multihost.put_tree(
+                self.mesh, self._pspecs,
+                partition_mod.stack_blocks(
+                    jax.tree.map(np.asarray, host_init)
+                ),
+            )
+        else:
+            self.params = multihost.put_tree(
+                self.mesh, self._pspecs, host_init
+            )
         # Flatten plans built from the HOST template (building them from
         # the placed tree would gather the tp shards just to read shapes).
         self._plan = _FlatPlan(host_init)
@@ -731,7 +839,17 @@ class SeqTrainer:
         # explicit reduction (psum / psum_scatter); a replication checker
         # would auto-psum the replicated-param cotangents and the
         # explicit reduction would then double-count.
-        if self._hplan is not None:
+        if self.config.pipeline_parallel > 1:
+            # Pipeline step: the schedule-tick scan over the pp axis
+            # (microbatch split, manual per-microbatch backward, Adam on
+            # pp/tp-placed state — pipeline.step); in/out specs mirror
+            # this trainer's param/opt placement exactly.
+            from ..pipeline.trainer import pipeline_shard_step
+
+            shard_step = pipeline_shard_step(
+                self.config, self.mesh, self._platform
+            )
+        elif self._hplan is not None:
             opt_spec = HybridAdam(
                 step=P(), m_flat=P(AXES), v_flat=P(AXES),
                 m_tp=list(self._hplan.tp_specs),
@@ -781,19 +899,29 @@ class SeqTrainer:
         return jax.jit(run, donate_argnums=donation_for(self.mesh, 0, 1))
 
     def _eval_fn(self):
-        sums = jax.shard_map(
-            _shard_sums(self.config, transformer.lm_correct_sums,
-                        self._platform),
-            mesh=self.mesh,
-            in_specs=(self._pspecs, P(None, SP_AXIS), P(None, SP_AXIS),
-                      P(None, SP_AXIS)),
-            out_specs=(P(), P()),
-            # No grads here, but the ring's causal lax.cond defeats
-            # replication checkers that lack a cond rule (pre-vma JAX);
-            # the trailing psums make the outputs replicated by
-            # construction either way.
-            check_vma=False,
-        )
+        if self.config.pipeline_parallel > 1:
+            # Forward-only pipeline eval (one microbatch, pp-1 stage
+            # hops, last stage scores — pipeline.step); same hit-sums
+            # contract and dp-replicated test placement as below.
+            from ..pipeline.trainer import pipeline_shard_eval
+
+            sums = pipeline_shard_eval(
+                self.config, self.mesh, self._platform, P(None, SP_AXIS)
+            )
+        else:
+            sums = jax.shard_map(
+                _shard_sums(self.config, transformer.lm_correct_sums,
+                            self._platform),
+                mesh=self.mesh,
+                in_specs=(self._pspecs, P(None, SP_AXIS), P(None, SP_AXIS),
+                          P(None, SP_AXIS)),
+                out_specs=(P(), P()),
+                # No grads here, but the ring's causal lax.cond defeats
+                # replication checkers that lack a cond rule (pre-vma JAX);
+                # the trailing psums make the outputs replicated by
+                # construction either way.
+                check_vma=False,
+            )
 
         def acc(params, tokens, targets, weights):
             num, den = sums(params, tokens, targets, weights)
@@ -817,12 +945,13 @@ class SeqTrainer:
 
     def _opt_like(self):
         """Host-shaped checkpoint template: Adam m/v as params-shaped
-        trees regardless of mode, so a checkpoint written by a zero1 run
-        resumes a replicated run (and vice versa) at ANY worker count —
-        the same layout-independence contract as the CNN trainers
-        (strategies/sync.py ``_opt_like``)."""
+        trees regardless of mode (STANDARD per-layer form, never the
+        pipeline's stacked form), so a checkpoint written by a zero1 or
+        pipeline run resumes a replicated run (and vice versa) at ANY
+        topology — the same layout-independence contract as the CNN
+        trainers (strategies/sync.py ``_opt_like``)."""
         zeros = jax.tree.map(
-            lambda l: np.zeros(l.shape, np.float32), dict(self.params)
+            lambda l: np.zeros(l.shape, np.float32), dict(self._host_like)
         )
         return AdamState(
             step=np.zeros((), np.int32),
@@ -830,8 +959,50 @@ class SeqTrainer:
             v=jax.tree.map(np.copy, zeros),
         )
 
+    def _params_for_save(self, params):
+        """Live params -> the checkpoint's standard host form (pipeline
+        runs unstack their [L, ...] block leaves back to the per-layer
+        list — the topology-free form every mode reads)."""
+        host = multihost.replicate_for_host(self.mesh, params)
+        if self._part is not None:
+            return self._partition.unstack_blocks(
+                jax.tree.map(np.asarray, host)
+            )
+        return host
+
+    def _place_params(self, host_tree):
+        """Checkpoint-form (standard) params -> this trainer's live
+        placement (stacked over pp for pipeline runs; Megatron shards
+        over tp; replicated otherwise)."""
+        if self._part is not None:
+            host_tree = self._partition.stack_blocks(
+                jax.tree.map(np.asarray, host_tree)
+            )
+        return multihost.put_tree(self.mesh, self._pspecs, host_tree)
+
+    def _result_params(self, params):
+        """Live params -> the LMResult host tree (standard form in every
+        mode, so downstream comparisons never see the stacked layout)."""
+        host = jax.device_get(params)
+        if self._part is not None:
+            return self._partition.unstack_blocks(host)
+        return host
+
     def _opt_for_save(self, opt_state):
         """Convert the live optimizer state to the checkpoint form."""
+        if self._part is not None:
+            # Pipeline: gather the pp/tp-sharded stacked m/v and unstack
+            # to the standard per-layer form (same layout-free contract
+            # as every other mode).
+            m, v = multihost.replicate_for_host(
+                self.mesh, (opt_state.m, opt_state.v)
+            )
+            unstack = lambda t: self._partition.unstack_blocks(
+                jax.tree.map(np.asarray, t)
+            )
+            return AdamState(
+                step=np.asarray(opt_state.step), m=unstack(m), v=unstack(v)
+            )
         if self._hplan is not None:
             # Hybrid: gather the flat (dp, sp) chunks AND the tp shards
             # (replicate_for_host reassembles each tp-sharded leaf), then
@@ -874,6 +1045,18 @@ class SeqTrainer:
         the hybrid split (elastic across ALL of them: a zero1 x tp save
         resumes replicated, tp-only, zero1-only, or at another
         topology — and vice versa)."""
+        if self._part is not None:
+            # Pipeline: stack the standard-form m/v into the [L, ...]
+            # block leaves and place like the params (stage-resident
+            # over pp, Megatron shards over tp).
+            stack = lambda t: self._partition.stack_blocks(
+                jax.tree.map(lambda a: np.asarray(a, np.float32), t)
+            )
+            return multihost.put_tree(
+                self.mesh, self._opt_specs,
+                AdamState(step=np.asarray(opt_tree.step),
+                          m=stack(opt_tree.m), v=stack(opt_tree.v)),
+            )
         if self._hplan is not None:
             n_dev = self.config.data_parallel * self.config.num_workers
             chunk = coll.chunk_size(self._hplan.rep_total, n_dev)
@@ -955,13 +1138,16 @@ class SeqTrainer:
         params = jax.tree.map(jnp.copy, self.params)
         opt_state = jax.tree.map(jnp.copy, self.opt_state)
         ckpt = checkpoint_file(checkpoint_dir)
+        # Resume template in CHECKPOINT form: standard params-shaped
+        # trees in every mode (a pipeline run's live params are stacked,
+        # but its checkpoints — like everyone else's — are not).
         tree, start_step = try_resume(
-            ckpt, resume, {"params": params, "opt": self._opt_like()}, log
+            ckpt, resume,
+            {"params": dict(self._host_like), "opt": self._opt_like()},
+            log,
         )
         if tree is not None:
-            params = multihost.put_tree(
-                self.mesh, self._pspecs, tree["params"]
-            )
+            params = self._place_params(tree["params"])
             opt_state = self._place_opt(tree["opt"])
         guarded(
             lambda: force(
@@ -1036,8 +1222,7 @@ class SeqTrainer:
                     ):
                         save_checkpoint(
                             ckpt,
-                            {"params": multihost.replicate_for_host(
-                                self.mesh, params),
+                            {"params": self._params_for_save(params),
                              "opt": self._opt_for_save(opt_state)},
                             step=gstep + k, extra={"epoch": epoch},
                         )
@@ -1065,7 +1250,7 @@ class SeqTrainer:
             f"({stats.images_per_sec:.0f} tokens/s)"
         )
         return LMResult(
-            params=jax.device_get(params),
+            params=self._result_params(params),
             final_accuracy=accuracy,
             final_loss=loss,
             wall_time_s=wall,

@@ -1,12 +1,15 @@
 """Data pipeline tests: reference pickle-format parity + procedural
-determinism (reference loader semantics: mnist_sync/model/model.py:6-14)."""
+determinism (reference loader semantics: mnist_sync/model/model.py:6-14),
+plus the LM prompt generator's determinism/bounds contract."""
 
 import os
 import pickle
 
 import numpy as np
+import pytest
 
 from ddl_tpu.data import load_mnist, one_hot
+from ddl_tpu.data.lm import synthesize_prompts
 from ddl_tpu.data.mnist import synthesize
 
 
@@ -33,6 +36,35 @@ def test_class_balance():
     _, y = synthesize(1000, seed=0)
     counts = np.bincount(y, minlength=10)
     assert counts.min() == counts.max() == 100
+
+
+def test_synthesize_prompts_deterministic_per_seed():
+    """Same seed -> identical prompt SET (lengths and payloads);
+    different seeds -> different; the serving benches depend on this to
+    compare runs (the batching-invariance pins replay one prompt list
+    across arrival patterns)."""
+    a = synthesize_prompts(num=16, min_len=4, max_len=24, vocab=64, seed=9)
+    b = synthesize_prompts(num=16, min_len=4, max_len=24, vocab=64, seed=9)
+    c = synthesize_prompts(num=16, min_len=4, max_len=24, vocab=64, seed=10)
+    assert len(a) == 16
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_synthesize_prompts_lengths_always_in_bounds():
+    """Lengths stay inside [min_len, max_len] INCLUSIVE across many
+    seeds (an off-by-one in the uniform draw would only surface rarely),
+    and the degenerate min_len == max_len case is exact — every prompt
+    that length, not max_len±1."""
+    for seed in range(8):
+        for p in synthesize_prompts(num=32, min_len=3, max_len=7,
+                                    vocab=16, seed=seed):
+            assert 3 <= len(p) <= 7, (seed, len(p))
+    fixed = synthesize_prompts(num=8, min_len=5, max_len=5, vocab=16,
+                               seed=0)
+    assert all(len(p) == 5 for p in fixed)
+    with pytest.raises(ValueError, match="min_len"):
+        synthesize_prompts(num=4, min_len=0, max_len=4, vocab=16, seed=0)
 
 
 def test_one_hot_matches_get_dummies_semantics():

@@ -1,0 +1,302 @@
+"""Pipeline parallelism (ddl_tpu/pipeline, models/partition stage split,
+SeqTrainer pipeline mode).
+
+The oracle chain, as everywhere in this repo: the W=1 full-attention
+``SeqTrainer`` is the reference numerics; the pipelined trainers (GPipe
+and 1F1B, alone and composed with dp / tp) must reproduce its loss,
+accuracy, and parameter trajectories on the 8-device virtual mesh to
+stated tolerance (atol 1e-5 / rtol 1e-4 — microbatch gradient
+accumulation and the backward's activation recompute reassociate fp32
+sums; there is no other numerical difference). Checkpoints must cross
+the pp ↔ non-pp boundary in both directions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ddl_tpu.data.lm import synthesize_copy
+from ddl_tpu.models.partition import (
+    pipeline_param_specs,
+    stack_blocks,
+    stage_partition,
+    unstack_blocks,
+)
+from ddl_tpu.models.transformer import TINY_SPEC, init_lm_params
+from ddl_tpu.pipeline.schedule import (
+    IDLE,
+    bubble_fraction,
+    buffer_slots,
+    max_in_flight,
+    predicted_bubble,
+    schedule_tables,
+)
+from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+
+SPEC = TINY_SPEC
+T = 32
+
+# The stated pipeline parity tolerance (microbatch-sum + recompute
+# reassociation only).
+TOL = dict(atol=1e-5, rtol=1e-4)
+
+
+def _params_close(a, b, **kw):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+# -- schedules ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("pp,m", [(2, 1), (2, 4), (4, 2), (4, 8), (3, 5)])
+def test_schedule_tables_wellformed(kind, pp, m):
+    """Every (stage, microbatch) forward and backward appears exactly
+    once, in microbatch order per stage, and respects the dependency
+    model: F(s,j) after F(s-1,j), B(s,j) after B(s+1,j) (last stage:
+    after its own F(s,j)) — each with at least one tick of ppermute
+    latency. Both schedules fill the same 2*(m+pp-1)-tick envelope."""
+    f_tab, b_tab = schedule_tables(kind, pp, m)
+    assert f_tab.shape == b_tab.shape == (pp, 2 * (m + pp - 1))
+    f_tick = {}
+    b_tick = {}
+    for s in range(pp):
+        fs = [(t, int(f_tab[s, t])) for t in range(f_tab.shape[1])
+              if f_tab[s, t] != IDLE]
+        bs = [(t, int(b_tab[s, t])) for t in range(b_tab.shape[1])
+              if b_tab[s, t] != IDLE]
+        assert [j for _, j in fs] == list(range(m)), (kind, s)
+        assert [j for _, j in bs] == list(range(m)), (kind, s)
+        # At most one unit of work per (stage, tick).
+        assert not {t for t, _ in fs} & {t for t, _ in bs}, (kind, s)
+        f_tick.update({(s, j): t for t, j in fs})
+        b_tick.update({(s, j): t for t, j in bs})
+    for s in range(pp):
+        for j in range(m):
+            if s > 0:
+                assert f_tick[(s, j)] > f_tick[(s - 1, j)], (kind, s, j)
+            if s < pp - 1:
+                assert b_tick[(s, j)] > b_tick[(s + 1, j)], (kind, s, j)
+            else:
+                assert b_tick[(s, j)] > f_tick[(s, j)], (kind, s, j)
+
+
+@pytest.mark.parametrize("pp,m", [(2, 4), (2, 8), (4, 8)])
+def test_schedule_memory_and_bubble(pp, m):
+    """The schedules' defining difference is warmup MEMORY, not bubble:
+    GPipe holds M in-flight stage inputs at its widest stage, 1F1B only
+    min(pp, M); with equal-cost ticks both realize the closed-form
+    bubble (pp-1)/(m+pp-1) — the analytic model pipeline_bubble.py
+    falsifies against wall-clock."""
+    g = schedule_tables("gpipe", pp, m)
+    o = schedule_tables("1f1b", pp, m)
+    assert max_in_flight(*g) == m
+    assert max_in_flight(*o) == min(pp, m)
+    assert buffer_slots(*g)["save"] == m
+    assert buffer_slots(*o)["save"] == min(pp, m)
+    expect = predicted_bubble(pp, m)
+    assert bubble_fraction(*g) == pytest.approx(expect)
+    assert bubble_fraction(*o) == pytest.approx(expect)
+    assert expect == pytest.approx((pp - 1) / (m + pp - 1))
+
+
+# -- stage partition / param layout ------------------------------------------
+
+
+def test_stage_partition_contract():
+    part = stage_partition(SPEC, 2)  # TINY_SPEC: 2 layers
+    assert part.layers_per_stage == 1
+    assert list(part.stage_layers(0)) == [0]
+    assert list(part.stage_layers(1)) == [1]
+    with pytest.raises(ValueError, match="divide num_layers"):
+        stage_partition(SPEC, 3)  # 2 % 3
+
+    params = jax.tree.map(
+        np.asarray, init_lm_params(jax.random.PRNGKey(0), SPEC)
+    )
+    stacked = stack_blocks(params)
+    assert stacked["blocks"]["wq"].shape == (2, 32, 32)
+    back = unstack_blocks(stacked)
+    _params_close(params, back, atol=0)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.mesh import PP_AXIS, TP_AXIS
+
+    specs = pipeline_param_specs(SPEC, 2, tensor_parallel=2)
+    # Every block leaf leads with the pp axis; Megatron col/row follow.
+    assert specs["blocks"]["wq"] == P(PP_AXIS, None, TP_AXIS)
+    assert specs["blocks"]["wo"] == P(PP_AXIS, TP_AXIS, None)
+    assert specs["blocks"]["ln1_g"] == P(PP_AXIS)
+    # embed/head/final-LN stay replicated (grads psum-broadcast over pp).
+    assert specs["embed"] == specs["head"] == P()
+    with pytest.raises(ValueError, match="divide num_layers"):
+        pipeline_param_specs(SPEC, 3)
+
+
+# -- trainer parity against the non-pipelined oracle -------------------------
+
+
+def test_pipeline_trainer_matches_oracle():
+    """pp=2 GPipe and 1F1B — alone, x dp=2, and x tp=2 — are the same
+    math as the W=1 full-attention oracle: identical short trainings
+    agree in final loss, eval accuracy (the forward-only pipeline eval
+    path), and every parameter, to the stated microbatch/recompute
+    tolerance. Also pins the placement: each pp position's addressable
+    block shard is exactly its stage's L/pp layers."""
+    ds = synthesize_copy(
+        num_train=32, num_test=16, seq_len=T, vocab=SPEC.vocab, seed=30
+    )
+    base = dict(epochs=2, batch_size=16, learning_rate=1e-3, eval_every=0,
+                num_workers=1, scheme="full", spec=SPEC, seed=15)
+    oracle = SeqTrainer(SeqConfig(**base), ds).train(log=lambda s: None)
+    configs = {
+        "pp2_gpipe": SeqConfig(pipeline_parallel=2, microbatches=4,
+                               pipeline_schedule="gpipe", **base),
+        "pp2_1f1b": SeqConfig(pipeline_parallel=2, microbatches=4,
+                              pipeline_schedule="1f1b", **base),
+        "dp2_pp2": SeqConfig(pipeline_parallel=2, microbatches=2,
+                             data_parallel=2, **base),
+        "tp2_pp2": SeqConfig(pipeline_parallel=2, microbatches=2,
+                             tensor_parallel=2,
+                             pipeline_schedule="1f1b", **base),
+        "dp2_tp2_pp2": SeqConfig(pipeline_parallel=2, microbatches=2,
+                                 data_parallel=2, tensor_parallel=2,
+                                 **base),
+    }
+    for tag, cfg in configs.items():
+        tr = SeqTrainer(cfg, ds)
+        wq = tr.params["blocks"]["wq"]  # stacked [L, e, e'], pp-sharded
+        shard = wq.addressable_shards[0].data.shape
+        e = SPEC.d_model
+        assert shard[0] == SPEC.num_layers // 2, (tag, shard)
+        assert shard[2] == (e // 2 if cfg.tensor_parallel > 1 else e), tag
+        r = tr.train(log=lambda s: None)
+        assert np.isclose(r.final_loss, oracle.final_loss, rtol=1e-4), (
+            tag, r.final_loss, oracle.final_loss
+        )
+        assert abs(r.final_accuracy - oracle.final_accuracy) < 1e-6, tag
+        _params_close(oracle.params, r.params, err_msg=tag, **TOL)
+
+
+def test_pipeline_checkpoint_elastic(tmp_path):
+    """pp-topology checkpoints are topology-free in BOTH directions: a
+    pp=2 save (stacked, stage-sharded live state written in the standard
+    per-layer form) resumes into a non-pp world, and a plain save
+    resumes under pp=2/1F1B; both match the uninterrupted plain golden
+    run."""
+    ds = synthesize_copy(
+        num_train=32, num_test=16, seq_len=T, vocab=SPEC.vocab, seed=31
+    )
+    base = dict(batch_size=16, learning_rate=1e-3, eval_every=0,
+                num_workers=1, scheme="full", spec=SPEC, seed=16)
+    pp_kw = dict(pipeline_parallel=2, microbatches=2)
+    golden = SeqTrainer(SeqConfig(epochs=2, **base), ds).train(
+        log=lambda s: None
+    )
+    for tag, save_kw, resume_kw in (
+        ("pp->plain", pp_kw, {}),
+        ("plain->pp", {}, dict(pipeline_schedule="1f1b", **pp_kw)),
+    ):
+        ckdir = str(tmp_path / tag.replace(">", "_"))
+        SeqTrainer(SeqConfig(epochs=1, **save_kw, **base), ds).train(
+            log=lambda s: None, checkpoint_dir=ckdir
+        )
+        crossed = SeqTrainer(
+            SeqConfig(epochs=2, **resume_kw, **base), ds
+        ).train(log=lambda s: None, checkpoint_dir=ckdir, resume=True)
+        assert crossed.resumed_from_step == 2, tag
+        _params_close(golden.params, crossed.params, err_msg=tag, **TOL)
+
+
+def test_pipeline_rejects_bad_configs():
+    """validate_topology: every rejected composition fails fast with a
+    fix in the message, before any device work (CI satellite)."""
+    ds = synthesize_copy(num_train=16, num_test=4, seq_len=T,
+                         vocab=SPEC.vocab, seed=0)
+    ok = dict(num_workers=1, scheme="full", batch_size=16, spec=SPEC)
+    good = SeqConfig(pipeline_parallel=2, microbatches=2, **ok)
+    good.validate_topology()  # the valid baseline must not raise
+    cases = [
+        (dict(pipeline_parallel=3, microbatches=3),
+         "divide num_layers"),  # 2 % 3
+        (dict(pipeline_parallel=2, microbatches=1), "microbatches > 1"),
+        (dict(pipeline_parallel=1, microbatches=2),
+         "requires pipeline_parallel"),
+        (dict(pipeline_parallel=2, microbatches=3),
+         "divide the global batch"),  # 16 % 3
+        (dict(pipeline_parallel=2, microbatches=4, data_parallel=3),
+         "divide the global batch"),  # 16 % (3*4)
+        (dict(pipeline_parallel=2, microbatches=2, zero1=True), "zero1"),
+        (dict(pipeline_parallel=2, microbatches=2,
+              pipeline_schedule="zigzag"), "pipeline_schedule"),
+        (dict(pipeline_parallel=0), "pipeline_parallel"),
+        (dict(microbatches=0), "microbatches"),
+    ]
+    for kw, match in cases:
+        cfg = SeqConfig(**{**ok, **kw})
+        with pytest.raises(ValueError, match=match):
+            cfg.validate_topology()
+    # Sequence x pipeline is rejected (composition matrix).
+    with pytest.raises(ValueError, match="num_workers=1"):
+        SeqConfig(num_workers=2, scheme="ring", batch_size=16, spec=SPEC,
+                  pipeline_parallel=2, microbatches=2).validate_topology()
+    # The trainer routes through the same gate.
+    with pytest.raises(ValueError, match="microbatches > 1"):
+        SeqTrainer(SeqConfig(pipeline_parallel=2, microbatches=1, **ok),
+                   ds)
+
+
+@pytest.mark.slow
+def test_pipeline_learns_copy_task_slow():
+    """End to end through the pipeline (pp=2, 1F1B, 10 epochs): the copy
+    task's scored targets live half a sequence back, so accuracy >>
+    chance certifies the whole pipelined training path — microbatch
+    streaming, manual backward, grad accumulation, Adam, the forward-
+    only pipeline eval. Long sweep, excluded from tier-1 (slow marker —
+    the schedule/parity pins above cover the gate)."""
+    ds = synthesize_copy(
+        num_train=256, num_test=64, seq_len=T, vocab=SPEC.vocab, seed=33
+    )
+    cfg = SeqConfig(
+        epochs=10, batch_size=32, learning_rate=3e-3, eval_every=0,
+        num_workers=1, scheme="full", pipeline_parallel=2, microbatches=4,
+        pipeline_schedule="1f1b", spec=SPEC, seed=1,
+    )
+    result = SeqTrainer(cfg, ds).train(log=lambda s: None)
+    chance = 1.0 / (SPEC.vocab - 1)
+    assert result.final_accuracy > 10 * chance, (
+        result.final_accuracy, result.history
+    )
+
+
+def test_pipeline_step_collective_schedule():
+    """The compiled pipeline step's cross-stage traffic is ACTIVATION
+    ppermutes — collective-permutes of [mb, T, E] blocks (one forward
+    activation + one backward cotangent hop per tick) — and never a
+    param-sized collective over pp: block gradients stay stage-resident
+    (the audit benchmarks/collective_bytes.py publishes)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from benchmarks.collective_bytes import audit_lm
+
+    row = audit_lm("pipeline", 1, 1, pp=2, microbatches=4)
+    permutes = [o for o in row["collectives"]
+                if o["op"] == "collective-permute"]
+    assert permutes, row["collectives"]
+    # The audit trains batch 8 over 4 microbatches at seq_len 8*sp:
+    # activation blocks are [mb=2, T=8, E=d_model].
+    act_elems = 2 * 8 * SPEC.d_model
+    assert any(o["max_elems"] == act_elems for o in permutes), (
+        act_elems, permutes
+    )
+    # No collective moves anything params-sized: the largest transfer
+    # in the whole schedule is bounded well below the param count.
+    total = row["total_params"]
+    for o in row["collectives"]:
+        assert o["max_elems"] < total, o
+    assert row["predicted_bubble"] == pytest.approx(1 / 5)

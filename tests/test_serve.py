@@ -267,6 +267,36 @@ def test_scheduler_slot_reuse_and_validation():
     assert stopped[6].tokens == [eos]
 
 
+def test_scheduler_rejects_oversized_prompt_before_any_admit():
+    """One prompt longer than the cache capacity among valid requests
+    fails the WHOLE submit with a ValueError naming that request id —
+    at validation time, before any slot prefills — never mid-run after
+    other slots were admitted. The engine keeps no partial state: the
+    same valid requests then serve normally on the same engine."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=16))
+    sched = Scheduler(eng)
+    valid = synthesize_prompts(num=2, min_len=4, max_len=6,
+                               vocab=SPEC.vocab, seed=11)
+    oversized = np.zeros(17, np.int32)  # 17 > capacity 16
+    reqs = [
+        Request(id=0, prompt=valid[0], max_new_tokens=2),
+        Request(id=7, prompt=oversized, max_new_tokens=2),
+        Request(id=2, prompt=valid[1], max_new_tokens=2),
+    ]
+    with pytest.raises(ValueError, match=r"request 7.*exceeds cache"):
+        sched.run(reqs)
+    # No partial admission happened: the valid pair still serves, and
+    # its outputs equal a fresh engine's (nothing leaked into the cache).
+    done, _ = sched.run([reqs[0], reqs[2]])
+    assert sorted(done) == [0, 2]
+    fresh = Scheduler(InferenceEngine(
+        ServeConfig(spec=SPEC, slots=2, capacity=16)
+    ))
+    done2, _ = fresh.run([reqs[0], reqs[2]])
+    assert {i: done[i].tokens for i in done} == \
+        {i: done2[i].tokens for i in done2}
+
+
 def test_params_only_checkpoint_load_from_zero1_tp(tmp_path):
     """ISSUE 2 satellite: a checkpoint written by SeqTrainer with
     --zero1 --tensor-parallel (the hybrid optimizer's save path) loads

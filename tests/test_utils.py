@@ -58,6 +58,66 @@ def test_step_timer():
     assert s.images_per_sec > 0
 
 
+def _timer_with(times_s):
+    """A StepTimer whose recorded step durations are exactly
+    ``times_s`` — percentile math must be pinnable on KNOWN samples,
+    not on wall-clock noise."""
+    t = StepTimer()
+    t._times = list(times_s)
+    t._images = [1] * len(times_s)
+    return t
+
+
+def test_step_stats_percentiles_known_samples():
+    """p50/p95/p99 on [10, 20, 30, 40] ms: the contract is
+    np.percentile's LINEAR-INTERPOLATION definition (not nearest-rank) —
+    p50 = midpoint 25ms, p95 = 38.5ms, p99 = 39.7ms. A silent switch to
+    nearest-rank would report 30/40/40 and skew every serving SLO row
+    (BASELINE.md percentile columns)."""
+    s = _timer_with([0.010, 0.020, 0.030, 0.040]).stats()
+    assert s.steps == 4
+    assert s.mean_ms == pytest.approx(25.0)
+    assert s.p50_ms == pytest.approx(25.0)
+    assert s.p95_ms == pytest.approx(38.5)
+    assert s.p99_ms == pytest.approx(39.7)
+    assert s.total_s == pytest.approx(0.100)
+
+
+def test_step_stats_percentiles_n1_n2_edges():
+    """The n=1 and n=2 edges, where nearest-rank and interpolation
+    definitions diverge most: one sample means EVERY percentile is that
+    sample; two samples interpolate between them (p50 = midpoint,
+    p95/p99 near — but below — the max; nearest-rank would snap all
+    three to the max)."""
+    s1 = _timer_with([0.012]).stats()
+    assert (s1.p50_ms, s1.p95_ms, s1.p99_ms) == (
+        pytest.approx(12.0), pytest.approx(12.0), pytest.approx(12.0)
+    )
+    s2 = _timer_with([0.010, 0.030]).stats()
+    assert s2.p50_ms == pytest.approx(20.0)
+    assert s2.p95_ms == pytest.approx(29.0)  # 10 + 0.95 * 20
+    assert s2.p99_ms == pytest.approx(29.8)  # 10 + 0.99 * 20
+    assert s2.p50_ms < s2.p95_ms < s2.p99_ms < 30.0
+
+
+def test_step_stats_warmup_exclusion_and_empty():
+    """Warmup steps leave the percentile window (but stay in total_s,
+    the throughput bracket); an all-warmup timer yields the zero
+    StepStats rather than a nan percentile."""
+    t = StepTimer(warmup=2)
+    t._times = [1.000, 1.000, 0.010, 0.030]
+    t._images = [1, 1, 1, 1]
+    s = t.stats()
+    assert s.steps == 2
+    assert s.p50_ms == pytest.approx(20.0)
+    assert t.total_s == pytest.approx(2.040)
+    empty = StepTimer(warmup=2)
+    empty._times = [1.0]
+    empty._images = [1]
+    z = empty.stats()
+    assert z.steps == 0 and z.p99_ms == 0.0
+
+
 def test_force_within_passes_normal_and_raises_on_hang():
     """Accelerator-death detection (force_within): a completing fetch is
     transparent, a genuinely wedged one raises with the --resume recovery
