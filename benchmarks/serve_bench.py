@@ -6,13 +6,26 @@ Measures the PRODUCT serving stack — the same compiled
 methodology: compile excluded via a warmup pass, every timed bracket
 closed by the scheduler's host token fetch (the true barrier).
 
-Three numbers per (slots, tensor_parallel) row, the serving SLO trio:
+Per (slots, tensor_parallel) row, the serving SLO set:
 
 - **prefill tok/s** — prompt ingestion bandwidth (bucketed full-forward)
 - **decode tok/s/slot** — steady-state per-sequence generation rate
 - **p50/p95/p99 per-token latency** — one decode step emits one token
   per active slot, so step latency IS per-token latency
   (``utils.metrics.StepTimer`` percentiles)
+- **TTFT p50/p95** — wall clock from arrival-eligibility to first token
+
+Plus two head-to-head sections (ISSUE 4; skip with ``--skip-compare``):
+
+- **prefix_compare** — the shared-prefix workload
+  (``synthesize_shared_prefix_prompts``) served with the prefix cache
+  off vs on: prefill-tokens-saved fraction, hit rate, TTFT, and a
+  ``tokens_identical`` integrity bit (the determinism contract checked
+  in situ, not just in tests).
+- **chunk_compare** — long prompts arriving while short requests
+  decode, chunked prefill off vs on: the inter-token-latency (ITL)
+  tail is the number chunking exists to bound — one whole-prompt
+  prefill between decode ticks IS the decoder stall.
 
     python benchmarks/serve_bench.py --json benchmarks/results/serve.json
 """
@@ -50,6 +63,17 @@ def main() -> None:
     ap.add_argument("--d-ff", type=int, default=2048)
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--prefix-len", type=int, default=96,
+                    help="shared family-prefix length for prefix_compare")
+    ap.add_argument("--prefill-chunk", type=int, default=64,
+                    help="chunk size (= per-tick budget) for chunk_compare")
+    ap.add_argument("--compare-repeats", type=int, default=3,
+                    help="timed runs per head-to-head arm; the best "
+                         "(min ITL p95) is recorded — single shots on "
+                         "the 1-2-core host carry ~40% noise spikes "
+                         "(the scaling.py best-of-N discipline)")
+    ap.add_argument("--skip-compare", action="store_true",
+                    help="sweep only; skip the prefix/chunk head-to-heads")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a JAX platform; '--platform cpu' runs the "
                          "virtual mesh (hermetic smoke) instead of waiting "
@@ -76,7 +100,10 @@ def main() -> None:
     import jax
 
     import bench
-    from ddl_tpu.data.lm import synthesize_prompts
+    from ddl_tpu.data.lm import (
+        synthesize_prompts,
+        synthesize_shared_prefix_prompts,
+    )
     from ddl_tpu.models.transformer import LMSpec
     from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
 
@@ -100,6 +127,117 @@ def main() -> None:
     failed = {}
     skipped = []
     measured = 0
+
+    def _measure(cfg, requests):
+        """Warmup (compile excluded) + best-of-N timed runs on one
+        engine (reset between reps — the scheduling, hits, and tokens
+        replay identically; only the clock varies). Best = min ITL p95,
+        the head-to-head sections' decision metric."""
+        eng = InferenceEngine(cfg)
+        sched = Scheduler(eng)
+        sched.warmup(requests)
+        best = None
+        for _ in range(max(1, args.compare_repeats)):
+            done, stats = sched.run(requests)
+            if best is None or stats.itl.p95_ms < best[1].itl.p95_ms:
+                best = (done, stats)
+            eng.reset()
+        return best
+
+    def _slo(stats):
+        return {
+            "prefill_tokens": stats.prefill_tokens,
+            "prefill_tokens_per_s": round(stats.prefill_tokens_per_s, 1),
+            "decode_p95_ms": round(stats.latency.p95_ms, 2),
+            "ttft_ms": {"p50": round(stats.ttft.p50_ms, 2),
+                        "p95": round(stats.ttft.p95_ms, 2)},
+            "itl_ms": {"p50": round(stats.itl.p50_ms, 2),
+                       "p95": round(stats.itl.p95_ms, 2),
+                       "p99": round(stats.itl.p99_ms, 2)},
+        }
+
+    base_cfg = dict(
+        spec=spec, slots=4, capacity=args.capacity,
+        temperature=args.temperature,
+        compute_dtype="bfloat16" if platform == "tpu" else None,
+    )
+    # Head-to-heads run FIRST: they are the PR-4 decision rows, and on
+    # this noise-prone host the later sections of a long process read
+    # systematically slower — the (slots x tp) sweep below is the
+    # regression anchor and tolerates that better than an A/B does.
+    prefix_compare = {}
+    chunk_compare = {}
+    if not args.skip_compare:
+        # -- prefix cache on/off on the shared-prefix workload ------------
+        fam_prompts = synthesize_shared_prefix_prompts(
+            n_families=4, per_family=4, prefix_len=args.prefix_len,
+            tail_min=8, tail_max=32, vocab=args.vocab, seed=1,
+        )
+        # Fully staggered arrivals: co-admitting two prompts of one
+        # family in the SAME tick makes both miss (neither registered
+        # yet) — real traffic interleaves, so should the workload.
+        fam_requests = [
+            Request(id=i, prompt=p, max_new_tokens=24, arrival=i)
+            for i, p in enumerate(fam_prompts)
+        ]
+        completions = {}
+        for label, px in (("prefix_off", 0), ("prefix_on", 4)):
+            try:
+                done, stats = _measure(
+                    ServeConfig(**base_cfg, prefix_slots=px), fam_requests
+                )
+            except Exception as e:  # noqa: BLE001 — record, don't discard
+                failed[label] = {"error_type": type(e).__name__,
+                                 "error": str(e)[:300]}
+                continue
+            completions[label] = {i: done[i].tokens for i in done}
+            total = stats.prefill_tokens + stats.prefill_tokens_saved
+            prefix_compare[label] = {
+                **_slo(stats),
+                "prefix_hit_rate": round(stats.prefix_hit_rate, 3),
+                "prefill_tokens_saved": stats.prefill_tokens_saved,
+                "saved_frac": round(
+                    stats.prefill_tokens_saved / total, 3
+                ) if total else 0.0,
+            }
+            print(f"[serve_bench] {label}: saved "
+                  f"{stats.prefill_tokens_saved} tok "
+                  f"(hit rate {stats.prefix_hit_rate:.0%}), ttft p95 "
+                  f"{stats.ttft.p95_ms:.0f}ms", file=sys.stderr)
+        if len(completions) == 2:
+            # The determinism contract, checked in situ.
+            prefix_compare["tokens_identical"] = (
+                completions["prefix_off"] == completions["prefix_on"]
+            )
+        # -- chunked prefill on/off under long prompts + decoders ---------
+        ck = args.prefill_chunk
+        long_len = min(args.capacity - 16, 384)
+        shorts = synthesize_prompts(num=3, min_len=8, max_len=16,
+                                    vocab=args.vocab, seed=2)
+        longs = synthesize_prompts(num=3, min_len=long_len,
+                                   max_len=long_len, vocab=args.vocab,
+                                   seed=3)
+        mix = [Request(id=i, prompt=p, max_new_tokens=48)
+               for i, p in enumerate(shorts)]
+        mix += [Request(id=10 + i, prompt=p, max_new_tokens=8,
+                        arrival=4 + 4 * i)
+                for i, p in enumerate(longs)]
+        for label, (chunk, budget) in (("chunk_off", (0, 0)),
+                                       ("chunk_on", (ck, ck))):
+            try:
+                _, stats = _measure(
+                    ServeConfig(**base_cfg, prefill_chunk=chunk,
+                                prefill_budget=budget), mix
+                )
+            except Exception as e:  # noqa: BLE001
+                failed[label] = {"error_type": type(e).__name__,
+                                 "error": str(e)[:300]}
+                continue
+            chunk_compare[label] = _slo(stats)
+            print(f"[serve_bench] {label}: itl p95 "
+                  f"{stats.itl.p95_ms:.0f}ms p99 {stats.itl.p99_ms:.0f}ms",
+                  file=sys.stderr)
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -139,6 +277,8 @@ def main() -> None:
                 "latency_ms": {"p50": round(lat.p50_ms, 2),
                                "p95": round(lat.p95_ms, 2),
                                "p99": round(lat.p99_ms, 2)},
+                "ttft_ms": {"p50": round(stats.ttft.p50_ms, 2),
+                            "p95": round(stats.ttft.p95_ms, 2)},
             }
             measured += 1
             print(f"[serve_bench] {tag}: prefill "
@@ -156,6 +296,11 @@ def main() -> None:
         "max_new_tokens": args.max_new_tokens,
         "num_prompts": args.num_prompts,
         "results": rows,
+        "prefix_compare": prefix_compare,
+        "chunk_compare": chunk_compare,
+        "prefix_len": args.prefix_len,
+        "prefill_chunk": args.prefill_chunk,
+        "compare_repeats": args.compare_repeats,
         "skipped_for_deadline": skipped,
         "failed": failed,
     }

@@ -272,6 +272,24 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--top-k", type=int, default=0,
                     help="restrict sampling to the k highest logits; "
                          "0 = full vocab (temperature > 0 only)")
+    sv.add_argument("--prefix-cache", type=int, default=0, metavar="SLOTS",
+                    help="prefix-cache pool width: retain completed "
+                         "prompts' K/V rows in SLOTS dedicated cache "
+                         "slots and admit new requests by copying their "
+                         "longest cached prefix (refcounted LRU "
+                         "eviction); 0 = off. Output tokens are "
+                         "bit-identical either way — only prefill work "
+                         "and TTFT change")
+    sv.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                    help="chunked prefill: stream prompts in N-token "
+                         "chunks interleaved with decode ticks (N a "
+                         "power of two >= 8 — one extra compiled "
+                         "bucket) so a long prompt stops stalling "
+                         "active decoders; 0 = whole-prompt prefill")
+    sv.add_argument("--prefill-budget", type=int, default=0, metavar="T",
+                    help="max prefill tokens per scheduler tick when "
+                         "chunking (>= --prefill-chunk); 0 = one chunk "
+                         "per tick, the maximum-interleaving default")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -495,7 +513,8 @@ _TRAIN_ONLY_DESTS = (
 )
 _SERVE_ONLY_DESTS = (
     "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
-    "prompt_max", "temperature", "top_k",
+    "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
+    "prefill_budget",
 )
 
 
@@ -675,6 +694,9 @@ def _run_serve(args) -> int:
         top_k=args.top_k,
         seed=args.seed,
         compute_dtype=_resolve_dtype(args),
+        prefix_slots=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
+        prefill_budget=args.prefill_budget,
     )
     if args.top_k and args.temperature <= 0:
         # Same flag hygiene as the variant-group rejects above: greedy
@@ -737,6 +759,12 @@ def _run_serve(args) -> int:
           f"{stats.decode_tokens_per_s_per_slot:.1f} tok/s/slot "
           f"({stats.slots} slots) | per-token latency p50 "
           f"{lat.p50_ms:.1f}ms p95 {lat.p95_ms:.1f}ms p99 {lat.p99_ms:.1f}ms")
+    print(f"ttft p50 {stats.ttft.p50_ms:.1f}ms p95 {stats.ttft.p95_ms:.1f}ms"
+          f" | itl p95 {stats.itl.p95_ms:.1f}ms")
+    if args.prefix_cache:
+        print(f"prefix cache: {stats.prefix_hits}/{stats.prefix_lookups} "
+              f"hits ({stats.prefix_hit_rate:.0%}), "
+              f"{stats.prefill_tokens_saved} prefill tokens saved")
     if args.json:
         print(json.dumps({
             "variant": "serve",
@@ -754,6 +782,12 @@ def _run_serve(args) -> int:
             "decode_steps": stats.decode_steps,
             "latency_ms": {"p50": lat.p50_ms, "p95": lat.p95_ms,
                            "p99": lat.p99_ms},
+            "ttft_ms": {"p50": stats.ttft.p50_ms, "p95": stats.ttft.p95_ms},
+            "itl_ms": {"p50": stats.itl.p50_ms, "p95": stats.itl.p95_ms,
+                       "p99": stats.itl.p99_ms},
+            "prefix_lookups": stats.prefix_lookups,
+            "prefix_hits": stats.prefix_hits,
+            "prefill_tokens_saved": stats.prefill_tokens_saved,
         }))
     return 0
 

@@ -115,3 +115,64 @@ def synthesize_prompts(
         ])
         for n in lens
     ]
+
+
+def synthesize_shared_prefix_prompts(
+    n_families: int = 4,
+    per_family: int = 4,
+    prefix_len: int = 16,
+    tail_min: int = 1,
+    tail_max: int = 8,
+    vocab: int = 64,
+    seed: int = 0,
+) -> list[np.ndarray]:
+    """Deterministic SHARED-PREFIX prompt workload for the serving
+    prefix cache (``ddl_tpu.serve.prefix``): ``n_families`` families of
+    ``per_family`` prompts each, every prompt in a family opening with
+    the same ``prefix_len``-token prefix (``[BOS, payload...]`` — the
+    system-prompt / few-shot-header shape) followed by its own tail of
+    uniform length in ``[tail_min, tail_max]``. Prompts return
+    ROUND-ROBIN across families (family 0's first, family 1's first,
+    ..., family 0's second, ...) so arrival-staggered benchmarks
+    interleave families the way real traffic mixes tenants, instead of
+    handing the cache one family at a time.
+
+    Same contracts as :func:`synthesize_prompts`: one seed, one prompt
+    list, everywhere; int32 arrays of VARIABLE length (the serving
+    stack owns padding/bucketing); token 0 reserved as BOS, payload in
+    ``[1, vocab)``. Distinct families get distinct prefixes by
+    construction is NOT guaranteed for tiny vocab/prefix combinations —
+    the draw is uniform — but collisions only make the workload easier
+    for a prefix cache, never wrong."""
+    if n_families < 1 or per_family < 1:
+        raise ValueError(
+            f"need n_families >= 1 and per_family >= 1, got "
+            f"{n_families}/{per_family}"
+        )
+    if prefix_len < 2:
+        raise ValueError(
+            f"prefix_len {prefix_len} must be >= 2 (BOS + >=1 shared "
+            f"payload token — a 1-token 'shared prefix' is just BOS)"
+        )
+    if not 1 <= tail_min <= tail_max:
+        raise ValueError(f"need 1 <= tail_min <= tail_max, got "
+                         f"{tail_min}/{tail_max}")
+    if vocab < 2:
+        raise ValueError(f"vocab {vocab} too small for payload + BOS")
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        np.concatenate([
+            np.zeros(1, np.int32),
+            rng.integers(1, vocab, size=prefix_len - 1, dtype=np.int32),
+        ])
+        for _ in range(n_families)
+    ]
+    prompts = []
+    for _ in range(per_family):
+        for f in range(n_families):
+            tail_len = int(rng.integers(tail_min, tail_max + 1))
+            prompts.append(np.concatenate([
+                prefixes[f],
+                rng.integers(1, vocab, size=tail_len, dtype=np.int32),
+            ]))
+    return prompts

@@ -259,6 +259,7 @@ def apply_lm_cached(
     *,
     start: jax.Array,
     positions: jax.Array | None = None,
+    rows: jax.Array | None = None,
     compute_dtype=None,
     row_reduce=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -277,7 +278,13 @@ def apply_lm_cached(
     per-token absolute positions (RoPE + the stored mask positions)
     without moving the write rows — pass ``PAD_POS`` at padded prompt
     tails so they are never attended, or far-past-training values to
-    probe RoPE extrapolation.
+    probe RoPE extrapolation. ``rows [B, T]`` overrides the write rows
+    themselves (decoupling both from ``start``) — the offset-prefill
+    path (``serve.engine``: prefill resuming at a nonzero position base
+    after a prefix-cache copy or an earlier chunk) uses it to redirect
+    PADDED bucket tails to row ``C`` (out of bounds — the scatter DROPS
+    them), so a power-of-two bucket overhanging the capacity can never
+    wrap onto live prefix rows.
 
     Parity contract: one prefill of ``tokens[:, :n]`` followed by
     one-token decode steps reproduces full-forward :func:`apply_lm`
@@ -299,7 +306,8 @@ def apply_lm_cached(
     h = params["embed"][tokens]  # [B, T, E]
     b, t, e = h.shape
     capacity = cache_k.shape[2]
-    rows = (start[:, None] + jnp.arange(t, dtype=start.dtype)) % capacity
+    if rows is None:
+        rows = (start[:, None] + jnp.arange(t, dtype=start.dtype)) % capacity
     if positions is None:
         positions = start[:, None] + jnp.arange(t, dtype=start.dtype)
     cache_pos = jax.vmap(lambda p, r, v: p.at[r].set(v))(

@@ -65,6 +65,24 @@ def append_rows(cache: jax.Array, new: jax.Array, rows: jax.Array) -> jax.Array:
     return jax.vmap(lambda c, n, r: c.at[r].set(n))(cache, new, rows)
 
 
+def copy_prefix(
+    dst: jax.Array, src: jax.Array, n: jax.Array, *, axis: int = 1
+) -> jax.Array:
+    """Rows ``[0, n)`` along ``axis`` take ``src``'s values; the rest keep
+    ``dst``'s — the slot-to-slot prefix-reuse gather behind the serving
+    prefix cache (``serve.prefix``): admitting a request whose prompt
+    shares a cached prefix becomes "copy the prefix's K/V rows, prefill
+    only the tail" instead of recomputing the prefix. ``n`` may be a
+    traced scalar (ONE compiled program covers every hit length — the
+    fixed-shape discipline of :func:`append_rows`). Rows are valid for
+    the new occupant because causal attention makes row ``r`` of a
+    prefix depend only on tokens ``0..r`` — identical by construction
+    when the first ``n`` tokens match."""
+    c = dst.shape[axis]
+    mask = (jnp.arange(c) < n).reshape((c,) + (1,) * (dst.ndim - axis - 1))
+    return jnp.where(mask, src, dst)
+
+
 def attend(
     q: jax.Array,
     k_cache: jax.Array,

@@ -3,6 +3,7 @@ batching — the inference half of the sharded-mesh story.
 
 - ``serve.cache``     — the slot-major ring-buffer KV cache pytree
 - ``serve.engine``    — the jitted (prefill, decode) pair on the tp mesh
+- ``serve.prefix``    — host prefix-cache index (trie + refcounted LRU)
 - ``serve.scheduler`` — continuous batching over the engine
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
@@ -17,11 +18,13 @@ Quickstart (also ``python -m ddl_tpu serve --help``)::
 """
 
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
+from .prefix import PrefixIndex  # noqa: F401
 from .scheduler import Completion, Request, Scheduler, ServeStats  # noqa: F401
 
 __all__ = [
     "Completion",
     "InferenceEngine",
+    "PrefixIndex",
     "Request",
     "Scheduler",
     "ServeConfig",

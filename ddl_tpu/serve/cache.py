@@ -26,11 +26,13 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import LMSpec
-from ..ops.kv_cache import PAD_POS
+from ..ops.kv_cache import PAD_POS, copy_prefix
 from ..parallel.mesh import TP_AXIS
 
 
@@ -63,6 +65,42 @@ def host_cache(
         k=np.zeros(shape, dtype),
         v=np.zeros(shape, dtype),
         pos=np.full((slots, capacity), PAD_POS, np.int32),
+    )
+
+
+def copy_slot_prefix(
+    dst: KVCache,
+    src: KVCache,
+    *,
+    src_slot: jax.Array,
+    dst_slot: jax.Array,
+    n: jax.Array,
+) -> KVCache:
+    """Copy the first ``n`` ring rows (K/V of every layer + positions) of
+    ``src_slot`` in ``src`` into ``dst_slot`` of ``dst`` — the pytree
+    form of ``ops.kv_cache.copy_prefix``, and the device half of prefix
+    reuse (``serve.prefix``): ``src`` and ``dst`` may be the SAME cache
+    (retained-slot reuse) or two caches sharing capacity/spec (the
+    dedicated prefix pool). Destination rows ``>= n`` reset to
+    ``PAD_POS`` so nothing of the previous occupant beyond the copied
+    prefix is ever attendable. All indices/lengths may be traced — one
+    compiled program per (cache shapes) pair. Head-dim tp sharding is
+    row-local, so the copy needs no collective inside ``shard_map``."""
+    sk = lax.dynamic_slice_in_dim(src.k, src_slot, 1, axis=1)
+    sv = lax.dynamic_slice_in_dim(src.v, src_slot, 1, axis=1)
+    sp = lax.dynamic_slice_in_dim(src.pos, src_slot, 1, axis=0)
+    dk = lax.dynamic_slice_in_dim(dst.k, dst_slot, 1, axis=1)
+    dv = lax.dynamic_slice_in_dim(dst.v, dst_slot, 1, axis=1)
+    rows = jnp.arange(dst.pos.shape[1])
+    new_pos = jnp.where(rows < n, sp[0], PAD_POS)[None, :].astype(dst.pos.dtype)
+    return KVCache(
+        k=lax.dynamic_update_slice_in_dim(
+            dst.k, copy_prefix(dk, sk, n, axis=2), dst_slot, axis=1
+        ),
+        v=lax.dynamic_update_slice_in_dim(
+            dst.v, copy_prefix(dv, sv, n, axis=2), dst_slot, axis=1
+        ),
+        pos=lax.dynamic_update_slice_in_dim(dst.pos, new_pos, dst_slot, axis=0),
     )
 
 

@@ -5,13 +5,22 @@ This is the device half of the serving subsystem (the host half — slot
 admission, eviction, batching policy — is ``serve.scheduler``). Two
 compiled programs cover a request's whole life:
 
-- **prefill**: one request's prompt (padded to a power-of-two bucket so
-  a handful of programs serve every length) runs through
+- **prefill**: one block of a request's prompt (padded to a power-of-two
+  bucket so a handful of programs serve every length) runs through
   ``transformer.apply_lm_cached`` in a single forward, writing rows
-  ``0..p-1`` of its slot and sampling the first output token from the
-  last real position's logits. The slot's stale ``pos`` rows are reset
-  to ``PAD_POS`` first, so a reused slot can never leak its previous
-  occupant's history into the new request's attention.
+  ``base..base+t-1`` of its slot and sampling sequence element
+  ``base + t`` from the last real position's logits. ``base == 0`` with
+  ``t == p`` is classic whole-prompt prefill; a nonzero ``base`` resumes
+  after a prefix-cache copy (``serve.prefix``) or an earlier CHUNK of
+  the same prompt (chunked prefill — the scheduler interleaves prompt
+  chunks with decode ticks so a long prompt cannot stall every active
+  slot). The slot's stale ``pos`` rows are reset to ``PAD_POS`` from
+  ``base`` on — never below it, which is exactly what keeps copied
+  prefix rows and earlier chunks attendable — so a reused slot can
+  never leak its previous occupant's history. Padded bucket-tail writes
+  redirect out of bounds (the scatter drops them), so a bucket
+  overhanging the capacity at a late ``base`` can never wrap onto live
+  prefix rows.
 - **decode**: ONE token per active slot, batched over all slots in a
   single fixed-shape program — each slot embeds its last token at its
   own absolute position (``rope`` takes per-slot ``[S, 1]`` positions),
@@ -26,6 +35,19 @@ Sampling is greedy at ``temperature == 0``, else temperature softmax
 step counter — so a request's tokens are bit-identical whether it runs
 alone or continuously batched with strangers at any arrival pattern
 (the scheduler-parity pin, tests/test_serve.py).
+
+**Prefix cache** (``prefix_slots > 0``): a dedicated pool — a second
+KVCache pytree of ``prefix_slots`` slots, NEVER part of the decode
+batch, so enabling the cache changes neither the decode program nor its
+cost — holds registered prompt prefixes; ``serve.prefix.PrefixIndex``
+(host trie + refcounted LRU) decides residency. Admission becomes: copy
+the longest-hit rows pool→slot (one jitted, donated gather program —
+``serve.cache.copy_slot_prefix``), then prefill only the tail at
+``base = hit``. Registration is the mirror copy slot→pool right after a
+prompt's prefill completes (before decode touches row ``p``). Copied
+rows are bit-identical to the rows a fresh prefill would write, so the
+determinism contract survives reuse exactly (pinned cache-on vs
+cache-off in tests/test_serve.py).
 
 Tensor parallelism reuses the training plumbing wholesale: params
 placed by ``models.partition.lm_param_specs``, the cache's head dim
@@ -51,14 +73,23 @@ from ..ops.kv_cache import PAD_POS
 from ..parallel import collectives as coll
 from ..parallel import multihost
 from ..parallel.mesh import TP_AXIS, donation_for, make_mesh
-from .cache import KVCache, cache_specs, host_cache
+from .cache import KVCache, cache_specs, copy_slot_prefix, host_cache
+from .prefix import PrefixIndex
 
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving topology + sampling policy. ``slots`` is the continuous-
     batching width (concurrent sequences); ``capacity`` bounds each
-    slot's prompt + generated length (the KV ring's row count)."""
+    slot's prompt + generated length (the KV ring's row count).
+
+    ``prefix_slots`` sizes the prefix-cache pool (0 = off).
+    ``prefill_chunk`` (0 = off; else a power of two >= 8, ONE more
+    bucket — not per-length programs) splits prompts into fixed chunks
+    the scheduler interleaves with decode ticks; ``prefill_budget``
+    caps prefill tokens per scheduler tick (0 = one chunk per tick,
+    the maximum-interleaving default; requires chunking, and must be
+    >= the chunk so every tick can make progress)."""
 
     spec: LMSpec = LMSpec()
     slots: int = 4
@@ -68,6 +99,9 @@ class ServeConfig:
     top_k: int = 0  # 0 = full vocab (temperature > 0 only)
     seed: int = 0
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
+    prefix_slots: int = 0  # prefix-cache pool width; 0 = off
+    prefill_chunk: int = 0  # chunked-prefill block; 0 = whole-prompt
+    prefill_budget: int = 0  # prefill tokens per scheduler tick; 0 = all
 
     def dtype(self):
         return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
@@ -118,6 +152,30 @@ class InferenceEngine:
                 f"top_k must be in [0, vocab={spec.vocab}], got "
                 f"{config.top_k}"
             )
+        if config.prefix_slots < 0:
+            raise ValueError(
+                f"prefix_slots must be >= 0, got {config.prefix_slots}"
+            )
+        ck = config.prefill_chunk
+        if ck and (ck < 8 or ck & (ck - 1)):
+            # Power-of-two >= 8: a chunk is ITS OWN prefill bucket (plus
+            # the smaller buckets any final partial chunk already uses),
+            # keeping the compiled-program count logarithmic.
+            raise ValueError(
+                f"prefill_chunk must be 0 or a power of two >= 8, got {ck}"
+            )
+        if config.prefill_budget:
+            if not ck:
+                raise ValueError(
+                    "prefill_budget requires prefill_chunk (the budget "
+                    "meters chunk interleaving; whole-prompt prefill "
+                    "ignores it silently otherwise)"
+                )
+            if config.prefill_budget < ck:
+                raise ValueError(
+                    f"prefill_budget ({config.prefill_budget}) below "
+                    f"prefill_chunk ({ck}) could never start a chunk"
+                )
         self.config = config
         # A 1-D tp mesh: serving has no data/sequence axis — the batch
         # dim is the slot dim, resident whole on every tp member.
@@ -132,6 +190,10 @@ class InferenceEngine:
         self._row_reduce = coll.tp_allreduce(TP_AXIS) if tp > 1 else None
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._copy_in = None  # pool slot -> cache slot (prefix hit)
+        self._copy_out = None  # cache slot -> pool slot (registration)
+        self.pool: KVCache | None = None
+        self.prefix: PrefixIndex | None = None
         self.reset()
 
     @classmethod
@@ -145,13 +207,23 @@ class InferenceEngine:
     # -- state -------------------------------------------------------------
 
     def reset(self) -> None:
-        """Fresh (empty) cache — every slot free, nothing attendable."""
+        """Fresh (empty) cache — every slot free, nothing attendable.
+        The prefix pool and its host index reset TOGETHER (an index
+        entry without its device rows, or vice versa, would be
+        corruption by construction)."""
         dtype = np.dtype(self.config.compute_dtype or np.float32)
         self.cache = multihost.put_tree(
             self.mesh, self._cspecs,
             host_cache(self.config.spec, self.config.slots,
                        self.config.capacity, dtype),
         )
+        if self.config.prefix_slots > 0:
+            self.pool = multihost.put_tree(
+                self.mesh, self._cspecs,
+                host_cache(self.config.spec, self.config.prefix_slots,
+                           self.config.capacity, dtype),
+            )
+            self.prefix = PrefixIndex(self.config.prefix_slots)
 
     def load_params(self, path) -> None:
         """Params-only checkpoint load (``utils.checkpoint.load_params``):
@@ -189,10 +261,10 @@ class InferenceEngine:
         slot slice, decode the ``[slots, 1]`` batch."""
         cfg = self.config
 
-        def body(params, cache: KVCache, tokens, start, positions):
+        def body(params, cache: KVCache, tokens, start, positions, rows=None):
             logits, k, v, pos = transformer.apply_lm_cached(
                 params, tokens, cache.k, cache.v, cache.pos, cfg.spec,
-                start=start, positions=positions,
+                start=start, positions=positions, rows=rows,
                 compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
             )
             return logits, KVCache(k=k, v=v, pos=pos)
@@ -200,30 +272,44 @@ class InferenceEngine:
         return body
 
     def _prefill_fn(self, bucket: int):
-        """Compiled prefill for prompts padded to ``bucket`` tokens:
-        ``(params, cache, tokens [1, bucket], length, slot, request_id)
-        -> (next_token, logits [bucket, vocab], cache)``."""
+        """Compiled prefill for prompt blocks padded to ``bucket``
+        tokens: ``(params, cache, tokens [1, bucket], length, base,
+        slot, request_id) -> (next_token, logits [bucket, vocab],
+        cache)``. ``base`` is the slot's position offset — 0 for a whole
+        prompt, the copied-prefix length after a prefix-cache hit, the
+        running offset for chunk 2+ of a chunked prefill. One program
+        per bucket covers every ``(length, base)``."""
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
         cfg = self.config
         fwd = self._shard_forward()
 
-        def shard_body(params, cache: KVCache, tokens, length, slot):
-            # Slot slice: [L, 1, C, H, D] k/v + [1, C] pos. The pos row
-            # resets to PAD_POS so the previous occupant's rows beyond
-            # this prompt can never be attended (k/v values may remain —
-            # masking on position makes them invisible).
+        def shard_body(params, cache: KVCache, tokens, length, base, slot):
+            # Slot slice: [L, 1, C, H, D] k/v + [1, C] pos. Stale pos
+            # rows reset to PAD_POS from `base` on — rows BELOW base are
+            # the copied prefix / earlier chunks and stay attendable;
+            # everything at or beyond is the previous occupant's and
+            # can never be attended (k/v values may remain — masking on
+            # position makes them invisible).
+            C = cache.pos.shape[1]
+            old_pos = lax.dynamic_slice_in_dim(cache.pos, slot, 1, axis=0)
             sl = KVCache(
                 k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
                 v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
-                pos=jnp.full((1, cache.pos.shape[1]), PAD_POS, jnp.int32),
+                pos=jnp.where(jnp.arange(C) < base, old_pos[0],
+                              PAD_POS)[None, :].astype(jnp.int32),
             )
             t = jnp.arange(bucket, dtype=jnp.int32)
-            # Padded tail positions are PAD_POS: written but never
-            # attendable, and overwritten by the first decode steps.
-            positions = jnp.where(t < length, t, PAD_POS)[None, :]
+            real = t < length
+            # Padded tail positions are PAD_POS and their WRITES
+            # redirect to row C — out of bounds, which XLA scatter
+            # DROPS — so a bucket overhanging the capacity at a late
+            # base can never wrap onto live prefix rows, with no
+            # sacrificial row and no edge case at base + length == C.
+            positions = jnp.where(real, base + t, PAD_POS)[None, :]
+            rows = jnp.where(real, (base + t) % C, C)[None, :]
             logits, sl = fwd(params, sl, tokens,
-                             jnp.zeros((1,), jnp.int32), positions)
+                             jnp.zeros((1,), jnp.int32), positions, rows)
             cache = KVCache(
                 k=lax.dynamic_update_slice_in_dim(cache.k, sl.k, slot, axis=1),
                 v=lax.dynamic_update_slice_in_dim(cache.v, sl.v, slot, axis=1),
@@ -236,19 +322,21 @@ class InferenceEngine:
         P_ = jax.sharding.PartitionSpec
         shard = jax.shard_map(
             shard_body, mesh=self.mesh,
-            in_specs=(self._pspecs, self._cspecs, P_(), P_(), P_()),
+            in_specs=(self._pspecs, self._cspecs, P_(), P_(), P_(), P_()),
             out_specs=(P_(), self._cspecs),
             check_vma=False,
         )
 
-        def run(params, cache, tokens, length, slot, request_id):
-            logits, cache = shard(params, cache, tokens, length, slot)
+        def run(params, cache, tokens, length, base, slot, request_id):
+            logits, cache = shard(params, cache, tokens, length, base, slot)
             last = lax.dynamic_index_in_dim(
                 logits, length - 1, axis=0, keepdims=False
             )
-            # The sampled token is sequence element `length` of this
-            # request — the token_index the PRNG key folds in.
-            nxt = self._sample(last, request_id, length)
+            # The sampled token is sequence element `base + length` of
+            # this request — the token_index the PRNG key folds in (only
+            # the block ending at the prompt's last token uses it; the
+            # scheduler discards mid-prompt samples).
+            nxt = self._sample(last, request_id, base + length)
             return nxt, logits, cache
 
         fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
@@ -292,6 +380,78 @@ class InferenceEngine:
         )
         return self._decode_fn
 
+    # -- prefix-cache device half ------------------------------------------
+
+    def _copy_fn(self, *, into_cache: bool):
+        """Compiled slot-to-slot prefix copy between the serving cache
+        and the prefix pool (``serve.cache.copy_slot_prefix`` under
+        ``shard_map``): ``into_cache=True`` is the HIT path (pool row
+        gather into a decode slot, cache donated), ``False`` the
+        REGISTRATION path (freshly prefilled prompt rows into a pool
+        slot, pool donated). One program each — slot indices and the
+        row count are traced."""
+        cached = self._copy_in if into_cache else self._copy_out
+        if cached is not None:
+            return cached
+
+        def shard_body(cache, pool, src_slot, dst_slot, n):
+            if into_cache:
+                return copy_slot_prefix(cache, pool, src_slot=src_slot,
+                                        dst_slot=dst_slot, n=n)
+            return copy_slot_prefix(pool, cache, src_slot=src_slot,
+                                    dst_slot=dst_slot, n=n)
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._cspecs, self._cspecs, P_(), P_(), P_()),
+            out_specs=self._cspecs,
+            check_vma=False,
+        )
+        fn = jax.jit(
+            shard,
+            donate_argnums=donation_for(self.mesh, 0 if into_cache else 1),
+        )
+        if into_cache:
+            self._copy_in = fn
+        else:
+            self._copy_out = fn
+        return fn
+
+    def prefix_fetch(self, entry_id: int, n: int, slot: int) -> None:
+        """HIT: copy the first ``n`` rows of pool entry ``entry_id``
+        into decode ``slot`` and pin the entry (refcount) until the
+        caller releases it — LRU pressure can never free a prefix a
+        live request was admitted from."""
+        e = self.prefix.entry(entry_id)
+        self.cache = self._copy_fn(into_cache=True)(
+            self.cache, self.pool,
+            jnp.int32(e.slot), jnp.int32(slot), jnp.int32(n),
+        )
+        self.prefix.touch(entry_id)
+        self.prefix.acquire(entry_id)
+
+    def prefix_release(self, entry_id: int) -> None:
+        self.prefix.release(entry_id)
+
+    def prefix_store(self, prompt, slot: int) -> bool:
+        """REGISTRATION: index ``prompt`` and snapshot its freshly
+        prefilled rows ``0..p-1`` from decode ``slot`` into the claimed
+        pool slot. Must run before the slot's first decode write (the
+        scheduler does — row ``p`` is still stale here). False = pool
+        full of pinned entries, registration skipped."""
+        prompt = np.asarray(prompt, np.int32)
+        got = self.prefix.insert(prompt)
+        if got is None:
+            return False
+        _, pool_slot = got
+        self.pool = self._copy_fn(into_cache=False)(
+            self.cache, self.pool,
+            jnp.int32(slot), jnp.int32(pool_slot),
+            jnp.int32(int(prompt.shape[0])),
+        )
+        return True
+
     # -- host API ----------------------------------------------------------
 
     def prefill_bucket(self, prompt_len: int) -> int:
@@ -307,21 +467,31 @@ class InferenceEngine:
             b *= 2
         return min(b, self.config.capacity)
 
-    def prefill(self, prompt, *, slot: int, request_id: int):
-        """Admit one prompt into ``slot``: writes rows ``0..p-1``,
-        samples sequence element ``p``. Returns ``(next_token int,
-        logits np [p, vocab])`` — the logits of every prompt position,
-        for parity pinning and scoring."""
+    def prefill(self, prompt, *, slot: int, request_id: int, base: int = 0):
+        """Prefill one prompt BLOCK into ``slot``: writes rows
+        ``base..base+t-1`` (positions likewise), samples sequence
+        element ``base + t``. ``base == 0`` with the whole prompt is
+        classic admission; ``base > 0`` resumes after a prefix-cache
+        copy or an earlier chunk — the sampled token is only meaningful
+        when the block ends at the prompt's last token. Returns
+        ``(next_token int, logits np [t, vocab])`` — the logits of
+        every position in the block, for parity pinning and scoring."""
         prompt = np.asarray(prompt, np.int32)
-        p = int(prompt.shape[0])
-        bucket = self.prefill_bucket(p)
+        t = int(prompt.shape[0])
+        if base < 0 or base + t > self.config.capacity:
+            raise ValueError(
+                f"prefill block [base={base}, base+{t}) outside cache "
+                f"capacity {self.config.capacity}"
+            )
+        bucket = self.prefill_bucket(t)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :p] = prompt
+        tokens[0, :t] = prompt
         nxt, logits, self.cache = self._prefill_fn(bucket)(
             self.params, self.cache, jnp.asarray(tokens),
-            jnp.int32(p), jnp.int32(slot), jnp.int32(request_id),
+            jnp.int32(t), jnp.int32(base), jnp.int32(slot),
+            jnp.int32(request_id),
         )
-        return int(nxt), np.asarray(logits)[:p]
+        return int(nxt), np.asarray(logits)[:t]
 
     def decode(self, last_tokens, lengths, request_ids, active):
         """One batched decode step over all slots. Host arrays in,

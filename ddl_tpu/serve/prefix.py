@@ -1,0 +1,172 @@
+"""Host-side prefix-cache index: a token trie over cached prompt
+prefixes, with refcounted LRU eviction over a fixed pool of slots.
+
+The serving analogue of the paper's redundant-traffic story: identical
+prompt prefixes (system prompts, few-shot headers, a family of requests
+sharing a long context) are recomputed per request unless their K/V rows
+are retained and reused. This module is the HOST half only — which
+prefixes are resident, where, and who may evict them; the device half is
+``serve.cache.copy_slot_prefix`` (slot-to-slot row copies), wired
+together by ``serve.engine``.
+
+Design decisions:
+
+- **A trie, not a scan**: every registered prefix's token path is
+  indexed node-by-node, each node holding the set of entries passing
+  through it, so ``match`` is one walk of the new prompt — O(prompt) —
+  returning the deepest node that some live entry covers. Causal
+  attention makes row ``r`` of a cached prefix depend only on tokens
+  ``0..r``, so ANY entry agreeing on the first ``d`` tokens donates
+  exactly the rows a fresh prefill of those ``d`` tokens would write:
+  matching a prefix of an entry is as good as matching the entry.
+- **Refcounts before LRU**: eviction (to admit a new prefix into a full
+  pool) considers only entries with zero readers. A request admitted
+  via a hit holds a reference until it completes, so the policy can
+  never free a prefix the serving layer still considers live — and a
+  full pool of pinned entries SKIPS registration rather than evicting
+  (``skipped_full`` counts it; the scheduler's stats surface it).
+- **Deterministic everywhere**: ties in ``match`` resolve to the
+  smallest entry id, LRU order is a monotone logical clock bumped by
+  touches (never wall time), so a replayed request sequence reproduces
+  the same hits, copies, and evictions bit-for-bit — the prefix cache
+  cannot break the scheduler determinism contract by construction.
+
+Pure Python, no JAX: unit-testable without a device
+(tests/test_serve.py pins the refcount/LRU contract directly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class _Node:
+    children: dict[int, "_Node"] = dataclasses.field(default_factory=dict)
+    holders: set[int] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class Entry:
+    """One resident prefix: ``tokens`` rows live in pool slot ``slot``."""
+
+    id: int
+    tokens: tuple[int, ...]
+    slot: int
+    refs: int = 0
+    last_used: int = 0
+
+
+class PrefixIndex:
+    """Trie + pool bookkeeping for ``slots`` resident prefixes."""
+
+    def __init__(self, slots: int):
+        if slots < 1:
+            raise ValueError(f"prefix pool needs >= 1 slot, got {slots}")
+        self.slots = slots
+        self._root = _Node()
+        self._entries: dict[int, Entry] = {}
+        self._free = list(range(slots - 1, -1, -1))  # pop() yields slot 0 first
+        self._next_id = 0
+        self._clock = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.skipped_full = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entry(self, entry_id: int) -> Entry:
+        return self._entries[entry_id]
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # -- lookup ------------------------------------------------------------
+
+    def match(self, tokens) -> tuple[int, int]:
+        """Longest registered prefix of ``tokens``: ``(entry_id, depth)``,
+        or ``(-1, 0)`` when nothing matches. PURE — no LRU stamp: every
+        BOS-led prompt trivially matches depth 1, and stamping unusable
+        matches would keep a dead entry perpetually recent while hot
+        prefixes paid the evictions; the caller :meth:`touch`-es the
+        entry it actually reuses. The depth is UNCAPPED — the caller
+        decides how much of a full-prompt match is usable (the engine
+        always re-prefills at least the last prompt token, since
+        sampling needs its logits)."""
+        node, depth, best = self._root, 0, (-1, 0)
+        for tok in tokens:
+            node = node.children.get(int(tok))
+            if node is None:
+                break
+            depth += 1
+            if node.holders:
+                best = (min(node.holders), depth)
+        return best
+
+    def touch(self, entry_id: int) -> None:
+        """Refresh the entry's LRU stamp — call on actual reuse only."""
+        self._entries[entry_id].last_used = self._tick()
+
+    # -- refcounts ---------------------------------------------------------
+
+    def acquire(self, entry_id: int) -> None:
+        self._entries[entry_id].refs += 1
+
+    def release(self, entry_id: int) -> None:
+        e = self._entries[entry_id]
+        if e.refs < 1:
+            raise ValueError(f"prefix entry {entry_id} released with no readers")
+        e.refs -= 1
+
+    # -- registration / eviction -------------------------------------------
+
+    def insert(self, tokens) -> tuple[int, int] | None:
+        """Claim a pool slot for ``tokens``: ``(entry_id, pool_slot)``,
+        evicting the least-recently-used ZERO-REF entry if the pool is
+        full, or ``None`` (registration skipped) when every resident
+        entry is pinned by a live reader. The caller performs the device
+        copy into the returned slot."""
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = min(
+                (e for e in self._entries.values() if e.refs == 0),
+                key=lambda e: e.last_used,
+                default=None,
+            )
+            if victim is None:
+                self.skipped_full += 1
+                return None
+            self._remove(victim)
+            self.evictions += 1
+            slot = self._free.pop()
+        eid = self._next_id
+        self._next_id += 1
+        self._entries[eid] = Entry(
+            id=eid, tokens=tuple(int(t) for t in tokens), slot=slot,
+            last_used=self._tick(),
+        )
+        node = self._root
+        for tok in self._entries[eid].tokens:
+            node = node.children.setdefault(tok, _Node())
+            node.holders.add(eid)
+        self.insertions += 1
+        return eid, slot
+
+    def _remove(self, e: Entry) -> None:
+        path = [self._root]
+        for tok in e.tokens:
+            path.append(path[-1].children[tok])
+        for node in path[1:]:
+            node.holders.discard(e.id)
+        # Prune childless, holderless tail nodes so the trie never grows
+        # beyond the live entries' token mass.
+        for parent, tok, node in reversed(
+            list(zip(path[:-1], e.tokens, path[1:]))
+        ):
+            if not node.children and not node.holders:
+                del parent.children[tok]
+        del self._entries[e.id]
+        self._free.append(e.slot)

@@ -4,34 +4,62 @@
 Static batching (run a batch to completion, then admit the next) leaves
 slots idle as soon as the first sequence finishes; continuous batching
 — the Orca/vLLM scheduling discipline — admits and evicts at TOKEN
-granularity: every step, finished sequences free their slots, waiting
+granularity: every tick, finished sequences free their slots, waiting
 requests prefill into them, and ONE fixed-shape decode program advances
 every active slot together. The device never sees the churn: admission
 is a prefill into a slot slice, eviction is host bookkeeping (the
 position-masked cache makes stale rows invisible, serve/cache.py).
 
-The scheduler is deliberately pure Python — policy lives here (arrival
-order, slot choice, stop conditions), device work lives in the jitted
-engine. Determinism contract: because sampling keys depend only on
-``(seed, request_id, token_index)`` and slot computation is
-row-independent, a request's output tokens are identical whatever mix
-of strangers shares the batch and whenever it arrives — pinned by
-tests/test_serve.py against per-request isolated runs.
+Two admission optimizations ride on the engine's offset prefill
+(ISSUE 4 tentpole), both OFF by default and bit-transparent when on:
 
-Metrics: prefill tok/s, decode tok/s/slot and per-token latency
-p50/p95/p99 via ``utils.metrics.StepTimer`` (each decode step emits one
-token per active slot, so step latency IS per-token latency).
+- **Prefix-cache reuse** (``ServeConfig.prefix_slots``): each admission
+  asks the engine's ``PrefixIndex`` for the longest cached prefix of
+  the prompt; a hit of >= ``MIN_PREFIX_HIT`` tokens becomes one device
+  row-copy plus a TAIL-only prefill at ``base = hit`` (at least the
+  last prompt token always re-prefills — sampling needs its logits).
+  Completed prompt prefills register back into the pool (refcounted
+  LRU, serve/prefix.py); a request admitted from an entry pins it until
+  the request finishes.
+- **Chunked prefill** (``ServeConfig.prefill_chunk``): prompts stream
+  in fixed chunks interleaved with decode ticks under a per-tick token
+  budget (``prefill_budget``), so one long prompt no longer stalls
+  every active decoder for its whole prefill — the inter-token-latency
+  tail (``ServeStats.itl``) is the metric it bounds. A slot being
+  chunk-prefilled is occupied but not yet decoding.
+
+The scheduler is deliberately pure Python — policy lives here (arrival
+order, slot choice, stop conditions, prefix/chunk policy), device work
+lives in the jitted engine. Determinism contract: sampling keys depend
+only on ``(seed, request_id, token_index)``, slot computation is
+row-independent, and copied prefix rows are bit-identical to the rows a
+fresh prefill would write — so a request's output tokens are identical
+whatever mix of strangers shares the batch, whenever it arrives, and
+whether the prefix cache or chunking is on or off (pinned by
+tests/test_serve.py against cache-off and isolated runs).
+
+Metrics: prefill tok/s, decode tok/s/slot, per-decode-step latency
+p50/p95/p99, TTFT (wall clock from arrival-eligibility to first
+token), ITL (gap between consecutive decode completions while slots
+stayed active — the stall chunking bounds), and prefix-cache
+hit-rate / prefill-tokens-saved.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 
 import numpy as np
 
 from ..utils.metrics import StepStats, StepTimer
 from .engine import InferenceEngine
+
+# A prefix hit shorter than this prefills normally: every BOS-led prompt
+# trivially shares its first token with every cached entry, and a
+# one-row copy is pure overhead dressed up as a hit.
+MIN_PREFIX_HIT = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +94,19 @@ class ServeStats:
     decode_s: float
     slots: int
     latency: StepStats  # per-decode-step = per-token percentiles
+    # Serving SLO additions (ISSUE 4): time-to-first-token per request
+    # (queueing + prefix copy + prefill), inter-token latency (decode-
+    # completion gaps INCLUDING interleaved prefill work — the stall
+    # chunked prefill bounds), and the prefix-cache ledger.
+    ttft: StepStats = dataclasses.field(
+        default_factory=lambda: StepStats.from_times([])
+    )
+    itl: StepStats = dataclasses.field(
+        default_factory=lambda: StepStats.from_times([])
+    )
+    prefix_lookups: int = 0
+    prefix_hits: int = 0
+    prefill_tokens_saved: int = 0
 
     @property
     def prefill_tokens_per_s(self) -> float:
@@ -79,31 +120,73 @@ class ServeStats:
     def decode_tokens_per_s_per_slot(self) -> float:
         return self.decode_tokens_per_s / self.slots
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        return (self.prefix_hits / self.prefix_lookups
+                if self.prefix_lookups else 0.0)
+
 
 class Scheduler:
     """Continuous-batching driver. One instance per engine; ``run`` is
-    synchronous and returns when every request has completed."""
+    synchronous and returns when every request has completed.
+    ``allow_window=True`` admits requests whose ``prompt +
+    max_new_tokens`` exceeds the cache capacity — the ring wraps and
+    attention degrades to an EXACT sliding window over the last
+    ``capacity`` positions mid-generation, which is a semantics change
+    the caller must opt into, never stumble into (the default rejects
+    at submit, naming the request)."""
 
-    def __init__(self, engine: InferenceEngine, *, eos_id: int | None = None):
+    def __init__(self, engine: InferenceEngine, *, eos_id: int | None = None,
+                 allow_window: bool = False):
         self.engine = engine
         self.eos_id = eos_id
+        self.allow_window = allow_window
 
     def warmup(self, requests) -> None:
-        """Compile the decode program and every prefill bucket
-        ``requests`` will need, OUTSIDE any timed run, then reset the
-        engine to a fresh cache — reported latency/throughput must
-        measure serving, not jit compilation (the BASELINE.md
-        methodology; shared by the serve CLI and serve_bench so the two
-        can never measure differently). Clones carry fresh negative ids
-        and generate at most 2 tokens (enough to compile decode whenever
-        the real run will decode at all)."""
+        """Compile the decode program and every prefill bucket / prefix
+        copy program ``requests`` will need, OUTSIDE any timed run, then
+        reset the engine to a fresh cache AND an empty prefix pool —
+        reported latency/throughput must measure serving, not jit (the
+        BASELINE.md methodology; shared by the serve CLI and
+        serve_bench so the two can never measure differently). Clones
+        carry fresh negative ids and generate at most 2 tokens (enough
+        to compile decode whenever the real run will decode at all) —
+        which changes slot-free timing vs the real run, so prefix-hit
+        TAIL lengths (and hence buckets) can differ between the two:
+        the whole power-of-two bucket ladder up to the largest prompt
+        is compiled explicitly below, plus both prefix copy programs,
+        so no admission path the real run takes can jit inside a timed
+        bracket."""
+        if not requests:
+            return
+        eng = self.engine
         self.run([
             dataclasses.replace(
-                r, id=-1 - i, arrival=0,
+                r, id=-1 - i,
                 max_new_tokens=min(2, r.max_new_tokens),
             )
             for i, r in enumerate(requests)
         ])
+        max_bucket = eng.prefill_bucket(max(
+            int(np.asarray(r.prompt).shape[0]) for r in requests
+        ))
+        b = 8
+        while True:
+            # min() also covers a capacity-capped (non-power-of-two)
+            # top bucket the doubling ladder would step over.
+            bucket = min(b, max_bucket)
+            eng.prefill(np.zeros(bucket, np.int32),
+                        slot=0, request_id=-1, base=0)
+            if bucket == max_bucket:
+                break
+            b *= 2
+        if eng.prefix is not None:
+            # One store + fetch compiles both copy programs even when
+            # the truncated clone run happened to produce no hit.
+            if eng.prefix_store(np.zeros(2, np.int32), 0):
+                entry, _ = eng.prefix.match(np.zeros(2, np.int32))
+                eng.prefix_fetch(entry, 2, 0)
+                eng.prefix_release(entry)
         self.engine.reset()
 
     def _validate(self, r: Request) -> None:
@@ -127,10 +210,15 @@ class Scheduler:
                 f"request {r.id}: prompt length {p} exceeds cache "
                 f"capacity {cap}"
             )
-        if p + r.max_new_tokens > cap:
+        if p + r.max_new_tokens > cap and not self.allow_window:
+            # Without the check the ring would silently wrap into
+            # sliding-window attention mid-generation — a semantics
+            # change, not an error, so it is opt-in only.
             raise ValueError(
                 f"request {r.id}: prompt ({p}) + max_new_tokens "
-                f"({r.max_new_tokens}) exceeds cache capacity {cap}"
+                f"({r.max_new_tokens}) exceeds cache capacity {cap} "
+                f"(pass allow_window=True to accept sliding-window "
+                f"attention once the ring wraps)"
             )
 
     def run(self, requests) -> tuple[dict[int, Completion], ServeStats]:
@@ -147,17 +235,58 @@ class Scheduler:
             sorted(requests, key=lambda r: (r.arrival, r.id))
         )
         # Host-side slot state, passed to the engine every decode step.
-        active = np.zeros(S, bool)
+        active = np.zeros(S, bool)  # decoding (prefill complete)
         lengths = np.zeros(S, np.int32)  # tokens resident in the cache
         last_tokens = np.zeros(S, np.int32)  # sampled, not yet appended
         req_ids = np.zeros(S, np.int32)
         occupant: list[Request | None] = [None] * S
         generated: list[list[int]] = [[] for _ in range(S)]
         admitted_at = np.zeros(S, np.int64)
+        prefilled = np.zeros(S, np.int64)  # prompt tokens already in cache
+        store_after = [False] * S  # register prompt in the pool when done
+        held_entry = [-1] * S  # pinned pool entry backing this admission
 
         done: dict[int, Completion] = {}
         prefill_timer = StepTimer()
         decode_timer = StepTimer()
+        eligible_wall: dict[int, float] = {}
+        ttfts: list[float] = []
+        itls: list[float] = []
+
+        try:
+            return self._drive(
+                requests, pending, occupant, active, lengths,
+                last_tokens, req_ids, generated, admitted_at, prefilled,
+                store_after, held_entry, done, prefill_timer,
+                decode_timer, eligible_wall, ttfts, itls,
+            )
+        finally:
+            # An exception mid-run (device failure, KeyboardInterrupt)
+            # must not leave pool entries pinned forever on an engine
+            # that outlives this run — orphaned refs would block every
+            # future eviction AND registration. Normal completion has
+            # already released everything (finish()), so this no-ops.
+            for s in range(S):
+                if held_entry[s] >= 0:
+                    eng.prefix_release(held_entry[s])
+                    held_entry[s] = -1
+
+    def _drive(self, requests, pending, occupant, active, lengths,
+               last_tokens, req_ids, generated, admitted_at, prefilled,
+               store_after, held_entry, done, prefill_timer,
+               decode_timer, eligible_wall, ttfts, itls):
+        """The tick loop behind :meth:`run` (split out so ``run`` can
+        guarantee pin release on ANY exit path)."""
+        eng = self.engine
+        cfg = eng.config
+        S = cfg.slots
+        chunk = cfg.prefill_chunk
+        # Unset budget defaults to ONE chunk per tick — maximum decode
+        # interleaving; chunking with an unmetered tick would run every
+        # chunk back-to-back and reintroduce the whole-prompt stall.
+        budget0 = cfg.prefill_budget or chunk
+        lookups = hits = saved = 0
+        last_decode_done: float | None = None
         step = 0
 
         def finish(s: int) -> None:
@@ -171,34 +300,112 @@ class Scheduler:
             )
             active[s] = False
             occupant[s] = None
+            if held_entry[s] >= 0:
+                eng.prefix_release(held_entry[s])
+                held_entry[s] = -1
 
         def finished(s: int, token: int) -> bool:
             return (len(generated[s]) >= occupant[s].max_new_tokens
                     or (self.eos_id is not None and token == self.eos_id))
 
-        while pending or active.any():
-            # Admit: fill every free slot whose turn has come. Prefill is
-            # per-request (its own timing bucket — a batched-prefill lane
-            # is a future optimization, ROADMAP).
+        while pending or any(o is not None for o in occupant):
+            # TTFT clock starts the first tick a request is eligible
+            # (arrival reached), whether or not a slot is free — the
+            # queueing delay is part of time-to-first-token.
+            now = time.perf_counter()
+            for r in pending:
+                if r.arrival > step:
+                    break  # pending is (arrival, id)-sorted
+                eligible_wall.setdefault(r.id, now)
+            # Admit: claim every free slot whose turn has come. With the
+            # prefix cache, admission itself is only the (optional) row
+            # copy — prompt compute happens in the prefill phase below.
             for s in range(S):
-                if active[s] or not pending or pending[0].arrival > step:
+                if occupant[s] is not None or not pending \
+                        or pending[0].arrival > step:
                     continue
                 r = pending.popleft()
                 p = int(np.asarray(r.prompt).shape[0])
-                with prefill_timer.step(images=p):
-                    tok, _ = eng.prefill(r.prompt, slot=s, request_id=r.id)
                 occupant[s] = r
-                active[s] = True
-                lengths[s] = p
-                last_tokens[s] = tok
-                req_ids[s] = r.id
-                generated[s] = [tok]
+                generated[s] = []
                 admitted_at[s] = step
-                if finished(s, tok):
-                    finish(s)
+                base = 0
+                store_after[s] = False
+                if eng.prefix is not None:
+                    lookups += 1
+                    entry, full = eng.prefix.match(r.prompt)
+                    hit = min(full, p - 1)
+                    if hit >= MIN_PREFIX_HIT:
+                        eng.prefix_fetch(entry, hit, s)
+                        held_entry[s] = entry
+                        base = hit
+                        hits += 1
+                        saved += hit
+                    # Register once the whole prompt is resident IF the
+                    # cache covers less than half of it: a true miss, or
+                    # a prompt extending its prefix meaningfully (the
+                    # multi-turn case — context + a long continuation).
+                    # Re-registering every hitting prompt would thrash
+                    # the pool instead: each unique-tail registration
+                    # evicts another family's live prefix, and the hit
+                    # rate collapses (measured in serve_bench's
+                    # prefix_compare before this policy existed).
+                    store_after[s] = full < max(p // 2, MIN_PREFIX_HIT)
+                prefilled[s] = base
+                # While this slot is mid-prefill, decode ticks still
+                # compute it (fixed shapes) and write one PAD_POS row at
+                # `lengths[s]` — keep that pointed at the NEXT chunk's
+                # first row (overwritten by the chunk anyway), never at
+                # a stale value that could stomp rows already resident.
+                lengths[s] = base
+            # Prefill: advance every occupied-but-not-active slot, whole
+            # prompt at once when chunking is off, else chunk-at-a-time
+            # under the shared per-tick token budget.
+            budget = budget0
+            for s in range(S):
+                r = occupant[s]
+                if r is None or active[s]:
+                    continue
+                prompt = np.asarray(r.prompt, np.int32)
+                p = int(prompt.shape[0])
+                while prefilled[s] < p:
+                    todo = p - int(prefilled[s])
+                    n = todo if not chunk else min(chunk, todo)
+                    if budget0 and budget < n:
+                        break  # out of tick budget; resume next tick
+                    base = int(prefilled[s])
+                    with prefill_timer.step(images=n):
+                        tok, _ = eng.prefill(
+                            prompt[base:base + n], slot=s,
+                            request_id=r.id, base=base,
+                        )
+                    prefilled[s] += n
+                    lengths[s] = prefilled[s]  # see admission comment
+                    if budget0:
+                        budget -= n
+                    if base + n == p:  # prompt complete: first token
+                        if eng.prefix is not None and store_after[s]:
+                            eng.prefix_store(prompt, s)
+                        active[s] = True
+                        lengths[s] = p
+                        last_tokens[s] = tok
+                        req_ids[s] = r.id
+                        generated[s] = [tok]
+                        ttfts.append(
+                            time.perf_counter() - eligible_wall[r.id]
+                        )
+                        if finished(s, tok):
+                            finish(s)
+                        break
             if active.any():
                 with decode_timer.step(images=int(active.sum())):
                     nxt, _ = eng.decode(last_tokens, lengths, req_ids, active)
+                now = time.perf_counter()
+                if last_decode_done is not None:
+                    # The gap since the previous decode completion —
+                    # prefill work interleaved between ticks included.
+                    itls.append(now - last_decode_done)
+                last_decode_done = now
                 for s in range(S):
                     if not active[s]:
                         continue
@@ -208,8 +415,12 @@ class Scheduler:
                     last_tokens[s] = tok
                     if finished(s, tok):
                         finish(s)
+            else:
+                # No decoder advanced this tick: the next decode's gap
+                # is idle/prefill lead-in, not an inter-token stall.
+                last_decode_done = None
             step += 1
-            if not active.any() and pending:
+            if all(o is None for o in occupant) and pending:
                 # Idle gap before the next arrival: every intervening
                 # step would admit and decode nothing, so jump straight
                 # to it instead of spinning one Python iteration per
@@ -225,5 +436,10 @@ class Scheduler:
             decode_s=decode_timer.total_s,
             slots=S,
             latency=latency,
+            ttft=StepStats.from_times(ttfts),
+            itl=StepStats.from_times(itls),
+            prefix_lookups=lookups,
+            prefix_hits=hits,
+            prefill_tokens_saved=saved,
         )
         return done, stats

@@ -38,6 +38,29 @@ class StepStats:
             f"throughput={self.images_per_sec:.0f} img/s"
         )
 
+    @classmethod
+    def from_times(cls, times_s, images=None) -> "StepStats":
+        """Percentile stats over raw per-event durations (seconds) —
+        the computation behind :meth:`StepTimer.stats`, exposed for
+        event streams that are not timer brackets (the serving TTFT and
+        inter-token-latency distributions, serve/scheduler.py).
+        ``images`` optionally weights throughput; absent, throughput
+        reads 0 (a latency-only distribution)."""
+        times = np.asarray(list(times_s), np.float64)
+        if times.size == 0:
+            return cls(0, 0.0, 0.0, 0.0, 0.0, 0.0)
+        total = float(times.sum())
+        n_images = float(np.sum(images)) if images is not None else 0.0
+        return cls(
+            steps=int(times.size),
+            mean_ms=float(times.mean() * 1e3),
+            p50_ms=float(np.percentile(times, 50) * 1e3),
+            p95_ms=float(np.percentile(times, 95) * 1e3),
+            p99_ms=float(np.percentile(times, 99) * 1e3),
+            total_s=total,
+            images_per_sec=n_images / total if total else 0.0,
+        )
+
 
 class StepTimer:
     """Per-step wall-clock timer with warmup exclusion.
@@ -80,19 +103,8 @@ class StepTimer:
         return int(sum(self._images))
 
     def stats(self) -> StepStats:
-        times = np.asarray(self._times[self.warmup :])
-        images = np.asarray(self._images[self.warmup :])
-        if times.size == 0:
-            return StepStats(0, 0.0, 0.0, 0.0, 0.0, 0.0)
-        total = float(times.sum())
-        return StepStats(
-            steps=int(times.size),
-            mean_ms=float(times.mean() * 1e3),
-            p50_ms=float(np.percentile(times, 50) * 1e3),
-            p95_ms=float(np.percentile(times, 95) * 1e3),
-            p99_ms=float(np.percentile(times, 99) * 1e3),
-            total_s=total,
-            images_per_sec=float(images.sum()) / total if total else 0.0,
+        return StepStats.from_times(
+            self._times[self.warmup :], self._images[self.warmup :]
         )
 
 

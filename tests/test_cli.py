@@ -226,3 +226,41 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
     rp = json.loads(resumed.stdout.strip().splitlines()[-1])
     assert rp["preempted"] is False
     assert rp["resumed_from_step"] > 0
+
+
+def test_main_serve_prefix_cache_and_chunked_prefill(capsys):
+    """The serve variant end-to-end through main() with the ISSUE 4
+    flags: a prefix-cache pool plus chunked prefill under a tick
+    budget, JSON contract carrying the new SLO fields (ttft/itl) and
+    the prefix ledger. Tiny model + 4 tokens/request keeps this inside
+    the tier-1 budget."""
+    assert main([
+        "serve", "--slots", "2", "--capacity", "64", "--max-new-tokens",
+        "4", "--num-prompts", "3", "--prompt-min", "6", "--prompt-max",
+        "12", "--vocab", "16", "--d-model", "32", "--heads", "2",
+        "--layers", "2", "--d-ff", "64", "--prefix-cache", "2",
+        "--prefill-chunk", "8", "--prefill-budget", "8", "--json",
+    ]) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["variant"] == "serve"
+    assert payload["config"]["prefix_slots"] == 2
+    assert payload["config"]["prefill_chunk"] == 8
+    assert payload["prefix_lookups"] == 3
+    assert payload["ttft_ms"]["p95"] > 0
+    assert len(payload["completions"]) == 3
+    assert all(len(c["tokens"]) == 4
+               for c in payload["completions"].values())
+
+
+def test_main_serve_rejects_bad_prefix_chunk_flags():
+    """Flag hygiene both ways: serve-only prefix/chunk flags fail
+    loudly on training variants, and invalid combinations fail as
+    config errors, not deep tracebacks."""
+    with pytest.raises(SystemExit, match="--prefix-cache"):
+        main(["lm", "--prefix-cache", "2"])
+    with pytest.raises(SystemExit, match="--prefill-chunk"):
+        main(["sync", "--prefill-chunk", "8"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--prefill-chunk", "12"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--prefill-budget", "16"])

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from ddl_tpu.data import load_mnist, one_hot
-from ddl_tpu.data.lm import synthesize_prompts
+from ddl_tpu.data.lm import synthesize_prompts, synthesize_shared_prefix_prompts
 from ddl_tpu.data.mnist import synthesize
 
 
@@ -65,6 +65,58 @@ def test_synthesize_prompts_lengths_always_in_bounds():
     assert all(len(p) == 5 for p in fixed)
     with pytest.raises(ValueError, match="min_len"):
         synthesize_prompts(num=4, min_len=0, max_len=4, vocab=16, seed=0)
+
+
+def test_shared_prefix_prompts_determinism_and_structure():
+    """ISSUE 4 satellite: the shared-prefix workload generator is
+    seed-deterministic, returns n_families * per_family prompts
+    ROUND-ROBIN across families (prompt i and i + n_families share a
+    family), every prompt opens with its family's exact prefix_len
+    prefix and differs beyond it in length or payload."""
+    kw = dict(n_families=3, per_family=4, prefix_len=10, tail_min=2,
+              tail_max=7, vocab=32)
+    a = synthesize_shared_prefix_prompts(seed=5, **kw)
+    b = synthesize_shared_prefix_prompts(seed=5, **kw)
+    c = synthesize_shared_prefix_prompts(seed=6, **kw)
+    assert len(a) == 12
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+    for i, p in enumerate(a):
+        fam = a[i % 3]  # the family's first prompt (round-robin order)
+        np.testing.assert_array_equal(p[:10], fam[:10])
+    # Families are distinct at this vocab/length (astronomically likely
+    # under the uniform draw; a collision would only EASE a prefix
+    # cache, never corrupt it — see the generator docstring).
+    assert not np.array_equal(a[0][:10], a[1][:10])
+
+
+def test_shared_prefix_prompts_bounds_and_validation():
+    """Lengths stay in [prefix_len + tail_min, prefix_len + tail_max]
+    inclusive across seeds; prompts are BOS-led with payload in
+    [1, vocab); malformed configs fail fast."""
+    for seed in range(6):
+        ps = synthesize_shared_prefix_prompts(
+            n_families=2, per_family=5, prefix_len=6, tail_min=1,
+            tail_max=4, vocab=16, seed=seed,
+        )
+        lens = {len(p) for p in ps}
+        assert lens <= set(range(7, 11)), lens
+        for p in ps:
+            assert p.dtype == np.int32 and p[0] == 0
+            assert (p[1:] >= 1).all() and (p[1:] < 16).all()
+    # The degenerate fixed-tail case is exact.
+    ps = synthesize_shared_prefix_prompts(n_families=1, per_family=3,
+                                          prefix_len=5, tail_min=3,
+                                          tail_max=3, vocab=8, seed=0)
+    assert all(len(p) == 8 for p in ps)
+    with pytest.raises(ValueError, match="prefix_len"):
+        synthesize_shared_prefix_prompts(prefix_len=1)
+    with pytest.raises(ValueError, match="tail_min"):
+        synthesize_shared_prefix_prompts(tail_min=5, tail_max=4)
+    with pytest.raises(ValueError, match="n_families"):
+        synthesize_shared_prefix_prompts(n_families=0)
+    with pytest.raises(ValueError, match="vocab"):
+        synthesize_shared_prefix_prompts(vocab=1)
 
 
 def test_one_hot_matches_get_dummies_semantics():

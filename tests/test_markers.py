@@ -1,0 +1,229 @@
+"""Tier-1 marker audit for the serving test surface (ISSUE 4 satellite).
+
+Serve tests are the suite's fastest-growing cost center: every scheduler
+run decodes tokens one compiled step at a time, and every topology in a
+sweep compiles its own program pair — on the single-host CPU gate that
+wall-clock adds up quickly. This audit makes the time-budget rule
+MECHANICAL instead of reviewer folklore: any test that drives the serve
+``Scheduler`` past either bound below must carry ``@pytest.mark.slow``
+(excluded from tier-1 via ``-m 'not slow'``), so serve growth cannot
+silently erode the tier-1 budget.
+
+Bounds (per test function, per run):
+
+- **> 64 total generated tokens** — estimated statically as
+  ``requests_per_run * max_new_tokens``, where ``requests_per_run`` is
+  the larger of the prompt-set size (literal ``num=`` /
+  ``n_families * per_family`` of a ``synthesize_*prompts`` call) and
+  the count of ``Request(...)`` constructor sites, and
+  ``max_new_tokens`` is the largest resolvable int literal passed under
+  that keyword. Code inside ``pytest.raises`` blocks is excluded (a
+  rejected request generates nothing).
+- **> 2 topologies** — the product of literal tuple/list lengths over
+  ``for`` loops whose bodies construct ``ServeConfig`` /
+  ``InferenceEngine`` (each iteration compiles a fresh engine).
+  ``pytest.mark.parametrize`` cases are separate tier-1 tests and are
+  deliberately NOT multiplied in.
+
+The estimate is a documented LOWER bound: unresolvable (non-literal)
+values contribute nothing, so the audit can miss creative obfuscation
+but can never false-positive on plain code. Pure AST — no jax import,
+no test execution; runs in milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import textwrap
+
+MAX_FAST_TOKENS = 64
+MAX_FAST_TOPOLOGIES = 2
+_PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts")
+_ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _const_int(node) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _kw_int(call: ast.Call, name: str) -> int | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return _const_int(kw.value)
+    return None
+
+
+def _raises_nodes(fn) -> set[int]:
+    """ids of every node inside a ``with pytest.raises(...)`` block —
+    requests built there are rejected, not served."""
+    skip: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        if any(
+            isinstance(item.context_expr, ast.Call)
+            and _call_name(item.context_expr) == "raises"
+            for item in node.items
+        ):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    skip.add(id(sub))
+    return skip
+
+
+def has_slow_marker(fn) -> bool:
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(node, ast.Attribute) and node.attr == "slow":
+            return True
+    return False
+
+
+def estimate(fn) -> tuple[bool, int, int]:
+    """``(uses_scheduler, est_tokens_per_run, est_topologies)`` for one
+    test function's AST (see module docstring for the metric)."""
+    skip = _raises_nodes(fn)
+    uses_scheduler = False
+    prompt_set = 0
+    request_sites = 0
+    max_new = 0
+    topologies = 1
+    for node in ast.walk(fn):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Name) and node.id == "Scheduler":
+            uses_scheduler = True
+        if isinstance(node, ast.For) and isinstance(
+            node.iter, (ast.Tuple, ast.List)
+        ):
+            sweeps_engine = any(
+                isinstance(sub, ast.Call) and _call_name(sub) in _ENGINE_CTORS
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            )
+            if sweeps_engine:
+                topologies *= max(1, len(node.iter.elts))
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "Request":
+            request_sites += 1
+            v = _kw_int(node, "max_new_tokens")
+            if v is not None:
+                max_new = max(max_new, v)
+        elif name == "synthesize_prompts":
+            v = _kw_int(node, "num")
+            if v is not None:
+                prompt_set = max(prompt_set, v)
+        elif name == "synthesize_shared_prefix_prompts":
+            fam = _kw_int(node, "n_families") or 1
+            per = _kw_int(node, "per_family") or 1
+            prompt_set = max(prompt_set, fam * per)
+    tokens = max(prompt_set, request_sites) * max_new
+    return uses_scheduler, tokens, topologies
+
+
+def _audit(tree) -> list[tuple[str, int, int]]:
+    """Violations ``(test_name, tokens, topologies)`` in one module."""
+    out = []
+    for fn in tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not fn.name.startswith("test"):
+            continue
+        uses, tokens, topo = estimate(fn)
+        if not uses or has_slow_marker(fn):
+            continue
+        if tokens > MAX_FAST_TOKENS or topo > MAX_FAST_TOPOLOGIES:
+            out.append((fn.name, tokens, topo))
+    return out
+
+
+def test_serve_scheduler_tests_carry_slow_marker():
+    """THE audit: every unmarked tier-1 test in this suite that drives
+    the serve Scheduler stays within 64 generated tokens per run and
+    2 swept topologies; anything bigger must be @pytest.mark.slow."""
+    violations = []
+    for path in sorted(pathlib.Path(__file__).parent.glob("test_*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        violations += [(path.name, *v) for v in _audit(tree)]
+    assert not violations, (
+        "serve-scheduler tests exceeding the tier-1 budget without "
+        "@pytest.mark.slow (file, test, est_tokens, est_topologies): "
+        f"{violations} — mark them slow or shrink the run "
+        f"(<= {MAX_FAST_TOKENS} tokens, <= {MAX_FAST_TOPOLOGIES} "
+        "topologies)"
+    )
+
+
+def test_audit_estimator_flags_and_permits():
+    """Pin the estimator itself on synthetic sources, so the audit's
+    teeth cannot rot silently: token overruns flag, topology sweeps
+    flag, slow-marked and in-budget tests pass, pytest.raises bodies
+    and non-Scheduler tests are exempt."""
+    src = textwrap.dedent("""
+        import pytest
+
+        def test_token_overrun():
+            prompts = synthesize_prompts(num=10, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=20)
+                    for i, p in enumerate(prompts)]
+            Scheduler(InferenceEngine(ServeConfig())).run(reqs)
+
+        def test_topology_sweep():
+            for slots in (1, 2, 4):
+                eng = InferenceEngine(ServeConfig(slots=slots))
+                Scheduler(eng).run([Request(id=0, prompt=p,
+                                            max_new_tokens=1)])
+
+        @pytest.mark.slow
+        def test_marked_overrun():
+            prompts = synthesize_prompts(num=100, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=64)
+                    for i, p in enumerate(prompts)]
+            Scheduler(InferenceEngine(ServeConfig())).run(reqs)
+
+        def test_in_budget():
+            ps = synthesize_shared_prefix_prompts(n_families=2,
+                                                  per_family=3)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=6)
+                    for i, p in enumerate(ps)]
+            Scheduler(InferenceEngine(ServeConfig())).run(reqs)
+
+        def test_rejected_requests_exempt():
+            sched = Scheduler(InferenceEngine(ServeConfig()))
+            with pytest.raises(ValueError):
+                sched.run([Request(id=0, prompt=p,
+                                   max_new_tokens=9999)])
+
+        def test_no_scheduler():
+            prompts = synthesize_prompts(num=500, min_len=4, max_len=8)
+            assert len(prompts) == 500
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_token_overrun", "test_topology_sweep"}
+    fns = {f.name: f for f in tree.body
+           if isinstance(f, ast.FunctionDef)}
+    assert has_slow_marker(fns["test_marked_overrun"])
+    uses, tokens, topo = estimate(fns["test_token_overrun"])
+    assert uses and tokens == 200 and topo == 1
+    _, tokens, topo = estimate(fns["test_topology_sweep"])
+    assert tokens == 1 and topo == 3
+    _, tokens, _ = estimate(fns["test_in_budget"])
+    assert tokens == 36
+    uses, tokens, _ = estimate(fns["test_rejected_requests_exempt"])
+    assert uses and tokens == 0

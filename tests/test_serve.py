@@ -20,13 +20,23 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ddl_tpu.data.lm import synthesize_copy, synthesize_prompts
+from ddl_tpu.data.lm import (
+    synthesize_copy,
+    synthesize_prompts,
+    synthesize_shared_prefix_prompts,
+)
 from ddl_tpu.models import transformer
 from ddl_tpu.models.transformer import TINY_SPEC
 from ddl_tpu.ops import kv_cache
 from ddl_tpu.ops.kv_cache import PAD_POS
 from ddl_tpu.parallel import ring
-from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+from ddl_tpu.serve import (
+    InferenceEngine,
+    PrefixIndex,
+    Request,
+    Scheduler,
+    ServeConfig,
+)
 
 SPEC = TINY_SPEC
 
@@ -361,6 +371,210 @@ def test_prompt_generator_contract():
         assert (x[1:] >= 1).all() and (x[1:] < 32).all()
     with pytest.raises(ValueError, match="min_len"):
         synthesize_prompts(min_len=5, max_len=4)
+
+
+# -- prefix cache + chunked prefill (ISSUE 4) --------------------------------
+
+
+def test_kv_copy_prefix_op():
+    """ops.kv_cache.copy_prefix: rows [0, n) along the axis take src,
+    the rest keep dst — for both the k/v layout ([L, 1, C, H, D],
+    axis=2) and a flat [B, C] layout (axis=1)."""
+    src = jnp.arange(24, dtype=jnp.float32).reshape(1, 1, 6, 2, 2) + 100
+    dst = jnp.arange(24, dtype=jnp.float32).reshape(1, 1, 6, 2, 2)
+    out = np.asarray(kv_cache.copy_prefix(dst, src, jnp.int32(4), axis=2))
+    np.testing.assert_array_equal(out[0, 0, :4], np.asarray(src)[0, 0, :4])
+    np.testing.assert_array_equal(out[0, 0, 4:], np.asarray(dst)[0, 0, 4:])
+    flat_src = jnp.ones((2, 5))
+    flat_dst = jnp.zeros((2, 5))
+    out = np.asarray(kv_cache.copy_prefix(flat_dst, flat_src, jnp.int32(2)))
+    np.testing.assert_array_equal(out[:, :2], 1.0)
+    np.testing.assert_array_equal(out[:, 2:], 0.0)
+
+
+@pytest.mark.parametrize("chunk", [8, 16])
+def test_chunked_prefill_logits_exactly_equal_one_shot(chunk):
+    """Acceptance pin: prefilling a prompt in fixed chunks (base
+    offsets) produces logits EXACTLY equal — bitwise, not tolerance —
+    to the one-shot prefill of the same prompt, partial final chunk
+    included. Exactness is what lets chunking default to 'safe to turn
+    on': the token stream cannot move."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=64))
+    prompt = synthesize_prompts(num=1, min_len=21, max_len=21,
+                                vocab=SPEC.vocab, seed=14)[0]
+    tok_full, logits_full = eng.prefill(prompt, slot=0, request_id=3)
+    eng.reset()
+    got = []
+    tok_last = None
+    for base in range(0, len(prompt), chunk):
+        tok_last, lg = eng.prefill(prompt[base:base + chunk], slot=0,
+                                   request_id=3, base=base)
+        got.append(lg)
+    np.testing.assert_array_equal(np.concatenate(got, axis=0), logits_full)
+    assert tok_last == tok_full  # same sampled element p
+
+
+def test_prefix_copy_then_tail_prefill_matches_full_prefill():
+    """The prefix-reuse device path: register prompt A's rows in the
+    pool, admit prompt B (sharing A's first tokens) as copy + tail
+    prefill — B's tail logits and first sampled token are EXACTLY the
+    full-prefill values (copied rows are bit-identical to recomputed
+    rows)."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=64,
+                                      prefix_slots=1))
+    fam = synthesize_shared_prefix_prompts(
+        n_families=1, per_family=2, prefix_len=12, tail_min=4, tail_max=4,
+        vocab=SPEC.vocab, seed=15,
+    )
+    a, b = fam[0], fam[1]
+    eng.prefill(a, slot=0, request_id=0)
+    assert eng.prefix_store(a, 0)
+    entry, hit = eng.prefix.match(b)
+    assert entry >= 0 and hit >= 12  # at least the family prefix
+    hit = min(hit, len(b) - 1)
+    # Reference: full prefill of b on a FRESH engine state.
+    ref_eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=64))
+    tok_ref, logits_ref = ref_eng.prefill(b, slot=1, request_id=7)
+    # Reused path: copy the hit rows into slot 1, prefill only the tail.
+    eng.prefix_fetch(entry, hit, 1)
+    tok, tail_logits = eng.prefill(b[hit:], slot=1, request_id=7, base=hit)
+    np.testing.assert_array_equal(tail_logits, logits_ref[hit:])
+    assert tok == tok_ref
+    eng.prefix_release(entry)
+
+
+def test_prefix_pool_lru_eviction_honors_refcounts():
+    """ISSUE 4 satellite pin, on the host index directly: a shared
+    prefix with a live reader survives pool pressure (LRU skips pinned
+    entries — a full pool of pinned entries SKIPS registration rather
+    than evicting); releasing the last reader makes it evictable
+    again."""
+    idx = PrefixIndex(2)
+    e0, s0 = idx.insert([0, 1, 2, 3])
+    e1, s1 = idx.insert([0, 5, 6, 7])
+    assert {s0, s1} == {0, 1} and len(idx) == 2
+    idx.acquire(e0)  # a live request attends e0's rows
+    idx.touch(e1)  # e1 is MRU, e0 strictly LRU — refcount must win
+    # match() is PURE: it never refreshes LRU stamps (a sub-threshold
+    # BOS-only match must not keep a dead entry recent).
+    before = idx.entry(e0).last_used
+    idx.match([0, 1, 2, 3, 4])
+    assert idx.entry(e0).last_used == before
+    got = idx.insert([0, 8, 8])  # pressure: must NOT evict pinned e0
+    assert got is not None
+    e2, _ = got
+    assert idx.entry(e0).tokens == (0, 1, 2, 3)  # pinned e0 survives
+    assert idx.evictions == 1  # e1 (LRU among ref-0) paid instead
+    with pytest.raises(KeyError):
+        idx.entry(e1)
+    idx.acquire(e2)
+    # Both residents pinned: registration is skipped, never an eviction.
+    assert idx.insert([0, 9, 9]) is None
+    assert idx.skipped_full == 1
+    # Releasing the LAST reader frees e0 for the next insertion.
+    idx.release(e0)
+    got = idx.insert([0, 9, 9])
+    assert got is not None
+    with pytest.raises(KeyError):
+        idx.entry(e0)
+    # Matching follows the trie: deepest live coverage wins.
+    eid, depth = idx.match([0, 9, 9, 1])
+    assert eid == got[0] and depth == 3
+    # Releasing an entry nobody holds is a bookkeeping bug, loudly.
+    with pytest.raises(ValueError, match="no readers"):
+        idx.release(eid)
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_prefix_cache_scheduler_determinism(tp):
+    """THE ISSUE 4 acceptance pin: a staggered-arrival shared-prefix
+    workload served with the prefix cache ON yields BIT-IDENTICAL
+    per-request tokens to the cache-off scheduler run — tp=1 and tp=2 —
+    while actually hitting (the stats prove reuse happened, so the pin
+    is not vacuous)."""
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=2, per_family=3, prefix_len=12, tail_min=2, tail_max=6,
+        vocab=SPEC.vocab, seed=16,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=6, arrival=i % 3)
+            for i, p in enumerate(prompts)]
+    off = Scheduler(InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=64, tensor_parallel=tp,
+    ))).run(reqs)[0]
+    on_eng = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=64, tensor_parallel=tp, prefix_slots=2,
+    ))
+    on, stats = Scheduler(on_eng).run(reqs)
+    assert stats.prefix_hits > 0 and stats.prefill_tokens_saved > 0
+    assert stats.prefix_lookups == len(reqs)
+    assert 0.0 < stats.prefix_hit_rate <= 1.0
+    assert stats.ttft.steps == len(reqs) and stats.ttft.p95_ms > 0
+    for r in reqs:
+        assert on[r.id].tokens == off[r.id].tokens, (tp, r.id)
+
+
+def test_chunked_prefill_scheduler_determinism_and_stats():
+    """Chunked prefill + per-tick budget (and the prefix cache on top)
+    cannot move any request's tokens — greedy AND seeded sampling —
+    and the inter-token-latency distribution is populated (the metric
+    chunking exists to bound)."""
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=2, per_family=3, prefix_len=12, tail_min=2, tail_max=6,
+        vocab=SPEC.vocab, seed=17,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=5, arrival=i % 2)
+            for i, p in enumerate(prompts)]
+    for kw in (dict(temperature=0.0),
+               dict(temperature=0.9, top_k=8, seed=12)):
+        off = Scheduler(InferenceEngine(ServeConfig(
+            spec=SPEC, slots=2, capacity=64, **kw,
+        ))).run(reqs)[0]
+        on, stats = Scheduler(InferenceEngine(ServeConfig(
+            spec=SPEC, slots=2, capacity=64, prefill_chunk=8,
+            prefill_budget=8, prefix_slots=2, **kw,
+        ))).run(reqs)
+        assert stats.itl.steps > 0
+        for r in reqs:
+            assert on[r.id].tokens == off[r.id].tokens, (kw, r.id)
+
+
+def test_scheduler_allow_window_opt_in():
+    """ISSUE 4 satellite: prompt + max_new_tokens beyond capacity is
+    rejected at submit naming the request — the ring would silently
+    wrap into sliding-window attention mid-generation — UNLESS the
+    caller passes allow_window=True, in which case the run completes
+    with the full token count (the window semantics are opt-in, tested
+    here end to end: resident length is capped at capacity while
+    absolute positions keep growing)."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=16))
+    prompt = synthesize_prompts(num=1, min_len=6, max_len=6,
+                                vocab=SPEC.vocab, seed=18)[0]
+    with pytest.raises(ValueError, match=r"request 9.*capacity 16"):
+        Scheduler(eng).run([Request(id=9, prompt=prompt,
+                                    max_new_tokens=14)])
+    done, _ = Scheduler(eng, allow_window=True).run(
+        [Request(id=9, prompt=prompt, max_new_tokens=14)]
+    )
+    assert len(done[9].tokens) == 14  # 6 + 14 = 20 > 16: ring wrapped
+    # Unchanged guard: the WINDOW escape hatch never admits a prompt
+    # longer than the cache itself.
+    with pytest.raises(ValueError, match=r"request 3.*exceeds cache"):
+        Scheduler(eng, allow_window=True).run(
+            [Request(id=3, prompt=np.zeros(17, np.int32),
+                     max_new_tokens=1)]
+        )
+
+
+def test_engine_rejects_bad_prefix_and_chunk_configs():
+    """Config validation fails fast with the fix in the message: odd
+    chunk sizes, budgets without chunking, budgets below the chunk,
+    negative pool widths."""
+    for bad in (dict(prefill_chunk=12), dict(prefill_chunk=4),
+                dict(prefill_budget=16), dict(prefix_slots=-1),
+                dict(prefill_chunk=16, prefill_budget=8)):
+        with pytest.raises(ValueError):
+            InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=32,
+                                        **bad))
 
 
 # -- long sweeps (excluded from tier-1 via -m 'not slow') --------------------
