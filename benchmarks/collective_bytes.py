@@ -168,10 +168,10 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1, pp: int = 1,
                   spec=TINY_SPEC),
         ds,
     )
-    xs = tr._stage(ds.tokens, 1, nseq)
-    ys = tr._stage(ds.targets, 1, nseq)
-    ws = tr._stage(ds.weights, 1, nseq)
-    txt = (tr._span_fn(1)
+    xs = tr.stage_batches(ds.tokens, 1, nseq)
+    ys = tr.stage_batches(ds.targets, 1, nseq)
+    ws = tr.stage_batches(ds.weights, 1, nseq)
+    txt = (tr.span_program(1)
            .lower(tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0))
            .compile().as_text())
     ops = collective_ops(txt)

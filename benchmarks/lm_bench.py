@@ -1,6 +1,6 @@
 """Long-context LM training throughput on the real chip.
 
-Times the PRODUCT sequence-parallel span program (``SeqTrainer._span_fn``
+Times the PRODUCT sequence-parallel span program (``SeqTrainer.span_program``
 — the same compiled object ``python -m ddl_tpu lm`` dispatches) at a
 sweep of sequence lengths on a 1-chip mesh, bf16, with bench.py's
 methodology: AOT compile outside the bracket, repeats of whole-span
@@ -86,6 +86,7 @@ def main() -> None:
     import bench
     from ddl_tpu.data.lm import synthesize_copy
     from ddl_tpu.models.transformer import LMSpec
+    from ddl_tpu.obs import MetricRegistry
     from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
     from ddl_tpu.train.trainer import force
 
@@ -106,6 +107,13 @@ def main() -> None:
     failed = {}
     measured = 0
     rows = {}
+    # Rep timings go through the obs registry (one labelled histogram
+    # series per (T, impl)) and the row stats are read back from it —
+    # the bench consumes the product telemetry surface, keeping its
+    # percentile math identical to every other consumer's (ISSUE 5).
+    reg = MetricRegistry()
+    spans = reg.histogram("lm_bench_span_seconds",
+                          "wall seconds per timed span dispatch")
     for T in args.seq_lens:
         if measured and left() < 240:
             skipped.append(f"T{T}")
@@ -131,26 +139,26 @@ def main() -> None:
                                 compute_dtype="bfloat16", batch_size=B,
                                 attn_impl=impl, spec=spec)
                 tr = SeqTrainer(cfg, ds)
-                xs = tr._stage(ds.tokens, k, B)
-                ys = tr._stage(ds.targets, k, B)
-                ws = tr._stage(ds.weights, k, B)
+                xs = tr.stage_batches(ds.tokens, k, B)
+                ys = tr.stage_batches(ds.targets, k, B)
+                ws = tr.stage_batches(ds.weights, k, B)
                 params, opt = tr.params, tr.opt_state
                 force((xs, ys, ws, params, opt), all_leaves=True)
                 t0 = time.perf_counter()
-                fn = (tr._span_fn(k)
+                fn = (tr.span_program(k)
                       .lower(params, opt, xs, ys, ws, jnp.int32(0))
                       .compile())
                 compile_s = time.perf_counter() - t0
                 params, opt, loss = fn(params, opt, xs, ys, ws,
                                        jnp.int32(0))
                 force((params, opt, loss))  # warmup barrier
-                tps = []
                 for _ in range(args.repeats):
                     t0 = time.perf_counter()
                     params, opt, loss = fn(params, opt, xs, ys, ws,
                                            jnp.int32(0))
                     force((params, opt, loss))  # true barrier: host fetch
-                    tps.append(k * B * T / (time.perf_counter() - t0))
+                    spans.observe(time.perf_counter() - t0,
+                                  seq_len=T, impl=impl)
             except Exception as e:  # noqa: BLE001 — record, don't discard
                 # Structured exception type alongside the message: the
                 # `failed` ledger must stay attributable post hoc (is a
@@ -161,7 +169,10 @@ def main() -> None:
                 print(f"[lm_bench] T={T} {impl} FAILED: {e}",
                       file=sys.stderr)
                 continue
-            best, med = float(max(tps)), float(np.median(tps))
+            times = spans.values(seq_len=T, impl=impl)
+            tokens = k * B * T
+            best = float(tokens / min(times))
+            med = float(np.median([tokens / t for t in times]))
             mfu = (round(100.0 * best * flops_per_token(spec, T) / peak, 2)
                    if peak else None)
             row[impl] = {

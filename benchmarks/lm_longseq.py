@@ -49,10 +49,10 @@ def measure(seq_len: int, workers: int, layout: str, steps: int,
         scheme="ring", seq_layout=layout, remat=remat, spec=spec,
     )
     tr = SeqTrainer(cfg, ds)
-    xs = tr._stage(ds.tokens, steps, batch)
-    ys = tr._stage(ds.targets, steps, batch)
-    ws = tr._stage(ds.weights, steps, batch)
-    compiled = tr._span_fn(steps).lower(
+    xs = tr.stage_batches(ds.tokens, steps, batch)
+    ys = tr.stage_batches(ds.targets, steps, batch)
+    ws = tr.stage_batches(ds.weights, steps, batch)
+    compiled = tr.span_program(steps).lower(
         tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0)
     ).compile()
     mem = compiled.memory_analysis()

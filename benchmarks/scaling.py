@@ -147,11 +147,11 @@ def bench_lm_ring(workers: int, steps: int, batch: int,
                   tensor_parallel=tp, spec=spec),
         ds,
     )
-    xs = tr._stage(ds.tokens, k, nseq)
-    ys = tr._stage(ds.targets, k, nseq)
-    ws = tr._stage(ds.weights, k, nseq)
+    xs = tr.stage_batches(ds.tokens, k, nseq)
+    ys = tr.stage_batches(ds.targets, k, nseq)
+    ws = tr.stage_batches(ds.weights, k, nseq)
     params, opt = tr.params, tr.opt_state
-    fn = tr._span_fn(k).lower(params, opt, xs, ys, ws, jnp.int32(0)).compile()
+    fn = tr.span_program(k).lower(params, opt, xs, ys, ws, jnp.int32(0)).compile()
     params, opt, loss = fn(params, opt, xs, ys, ws, jnp.int32(0))  # warmup
     force((params, opt, loss))
     calls = max(1, steps // k)

@@ -27,6 +27,11 @@ Plus two head-to-head sections (ISSUE 4; skip with ``--skip-compare``):
   tail is the number chunking exists to bound — one whole-prompt
   prefill between decode ticks IS the decoder stall.
 
+Every row is read from the ``ddl_tpu.obs`` MetricRegistry the
+scheduler publishes (counters + latency histograms observed from the
+same timer brackets ``ServeStats`` is built from) — the bench consumes
+the product telemetry surface, not private scheduler state (ISSUE 5).
+
     python benchmarks/serve_bench.py --json benchmarks/results/serve.json
 """
 
@@ -105,6 +110,7 @@ def main() -> None:
         synthesize_shared_prefix_prompts,
     )
     from ddl_tpu.models.transformer import LMSpec
+    from ddl_tpu.obs import MetricRegistry
     from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
 
     spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
@@ -132,28 +138,43 @@ def main() -> None:
         """Warmup (compile excluded) + best-of-N timed runs on one
         engine (reset between reps — the scheduling, hits, and tokens
         replay identically; only the clock varies). Best = min ITL p95,
-        the head-to-head sections' decision metric."""
+        the head-to-head sections' decision metric. Every rep gets a
+        FRESH MetricRegistry (ISSUE 5: the bench reads the registry the
+        scheduler publishes — the product telemetry surface — not
+        private scheduler state); returns ``(done, registry)`` of the
+        best rep."""
         eng = InferenceEngine(cfg)
         sched = Scheduler(eng)
         sched.warmup(requests)
-        best = None
+        best = best_key = None
         for _ in range(max(1, args.compare_repeats)):
-            done, stats = sched.run(requests)
-            if best is None or stats.itl.p95_ms < best[1].itl.p95_ms:
-                best = (done, stats)
+            sched.registry = reg = MetricRegistry()
+            done, _ = sched.run(requests)
+            itl_p95 = reg.histogram("serve_itl_seconds").stats().p95_ms
+            if best is None or itl_p95 < best_key:
+                best, best_key = (done, reg), itl_p95
             eng.reset()
         return best
 
-    def _slo(stats):
+    def _slo(reg):
+        """The SLO row, read from the run's registry: latency
+        histograms observe the same timer brackets the scheduler's own
+        ServeStats are built from, so these are the product numbers."""
+        ttft = reg.histogram("serve_ttft_seconds").stats()
+        itl = reg.histogram("serve_itl_seconds").stats()
+        dec = reg.histogram("serve_decode_step_seconds").stats()
+        prefill_tokens = int(reg.counter("serve_prefill_tokens_total").value())
+        prefill_s = reg.histogram("serve_prefill_seconds").stats().total_s
         return {
-            "prefill_tokens": stats.prefill_tokens,
-            "prefill_tokens_per_s": round(stats.prefill_tokens_per_s, 1),
-            "decode_p95_ms": round(stats.latency.p95_ms, 2),
-            "ttft_ms": {"p50": round(stats.ttft.p50_ms, 2),
-                        "p95": round(stats.ttft.p95_ms, 2)},
-            "itl_ms": {"p50": round(stats.itl.p50_ms, 2),
-                       "p95": round(stats.itl.p95_ms, 2),
-                       "p99": round(stats.itl.p99_ms, 2)},
+            "prefill_tokens": prefill_tokens,
+            "prefill_tokens_per_s":
+                round(prefill_tokens / prefill_s, 1) if prefill_s else 0.0,
+            "decode_p95_ms": round(dec.p95_ms, 2),
+            "ttft_ms": {"p50": round(ttft.p50_ms, 2),
+                        "p95": round(ttft.p95_ms, 2)},
+            "itl_ms": {"p50": round(itl.p50_ms, 2),
+                       "p95": round(itl.p95_ms, 2),
+                       "p99": round(itl.p99_ms, 2)},
         }
 
     base_cfg = dict(
@@ -183,7 +204,7 @@ def main() -> None:
         completions = {}
         for label, px in (("prefix_off", 0), ("prefix_on", 4)):
             try:
-                done, stats = _measure(
+                done, reg = _measure(
                     ServeConfig(**base_cfg, prefix_slots=px), fam_requests
                 )
             except Exception as e:  # noqa: BLE001 — record, don't discard
@@ -191,19 +212,22 @@ def main() -> None:
                                  "error": str(e)[:300]}
                 continue
             completions[label] = {i: done[i].tokens for i in done}
-            total = stats.prefill_tokens + stats.prefill_tokens_saved
+            saved = int(reg.counter("serve_prefill_tokens_saved_total").value())
+            hits = int(reg.counter("serve_prefix_hits_total").value())
+            lookups = int(reg.counter("serve_prefix_lookups_total").value())
+            hit_rate = hits / lookups if lookups else 0.0
+            prefilled = int(reg.counter("serve_prefill_tokens_total").value())
+            total = prefilled + saved
+            ttft_p95 = reg.histogram("serve_ttft_seconds").stats().p95_ms
             prefix_compare[label] = {
-                **_slo(stats),
-                "prefix_hit_rate": round(stats.prefix_hit_rate, 3),
-                "prefill_tokens_saved": stats.prefill_tokens_saved,
-                "saved_frac": round(
-                    stats.prefill_tokens_saved / total, 3
-                ) if total else 0.0,
+                **_slo(reg),
+                "prefix_hit_rate": round(hit_rate, 3),
+                "prefill_tokens_saved": saved,
+                "saved_frac": round(saved / total, 3) if total else 0.0,
             }
-            print(f"[serve_bench] {label}: saved "
-                  f"{stats.prefill_tokens_saved} tok "
-                  f"(hit rate {stats.prefix_hit_rate:.0%}), ttft p95 "
-                  f"{stats.ttft.p95_ms:.0f}ms", file=sys.stderr)
+            print(f"[serve_bench] {label}: saved {saved} tok "
+                  f"(hit rate {hit_rate:.0%}), ttft p95 "
+                  f"{ttft_p95:.0f}ms", file=sys.stderr)
         if len(completions) == 2:
             # The determinism contract, checked in situ.
             prefix_compare["tokens_identical"] = (
@@ -225,7 +249,7 @@ def main() -> None:
         for label, (chunk, budget) in (("chunk_off", (0, 0)),
                                        ("chunk_on", (ck, ck))):
             try:
-                _, stats = _measure(
+                _, reg = _measure(
                     ServeConfig(**base_cfg, prefill_chunk=chunk,
                                 prefill_budget=budget), mix
                 )
@@ -233,9 +257,10 @@ def main() -> None:
                 failed[label] = {"error_type": type(e).__name__,
                                  "error": str(e)[:300]}
                 continue
-            chunk_compare[label] = _slo(stats)
+            chunk_compare[label] = _slo(reg)
+            itl = reg.histogram("serve_itl_seconds").stats()
             print(f"[serve_bench] {label}: itl p95 "
-                  f"{stats.itl.p95_ms:.0f}ms p99 {stats.itl.p99_ms:.0f}ms",
+                  f"{itl.p95_ms:.0f}ms p99 {itl.p99_ms:.0f}ms",
                   file=sys.stderr)
 
     for tp in args.tensor_parallel:
@@ -255,35 +280,49 @@ def main() -> None:
                     tensor_parallel=tp, temperature=args.temperature,
                     compute_dtype="bfloat16" if platform == "tpu" else None,
                 ))
-                sched = Scheduler(eng)
+                reg = MetricRegistry()
+                sched = Scheduler(eng, registry=reg)
                 # Compile outside the timed run (the shared methodology
-                # helper — one definition for the CLI and this bench).
+                # helper — one definition for the CLI and this bench;
+                # warmup suppresses its own telemetry).
                 sched.warmup(requests)
-                _, stats = sched.run(requests)
+                sched.run(requests)
             except Exception as e:  # noqa: BLE001 — record, don't discard
                 failed[tag] = {"error_type": type(e).__name__,
                                "error": str(e)[:300]}
                 print(f"[serve_bench] {tag} FAILED: {e}", file=sys.stderr)
                 continue
-            lat = stats.latency
+            # Row fields read from the registry the scheduler published
+            # (histograms observe the same brackets ServeStats uses).
+            lat = reg.histogram("serve_decode_step_seconds").stats()
+            ttft = reg.histogram("serve_ttft_seconds").stats()
+            prefill_tokens = int(
+                reg.counter("serve_prefill_tokens_total").value()
+            )
+            prefill_s = reg.histogram("serve_prefill_seconds").stats().total_s
+            decode_tokens = int(
+                reg.counter("serve_decode_tokens_total").value()
+            )
+            prefill_tps = prefill_tokens / prefill_s if prefill_s else 0.0
+            decode_tps = decode_tokens / lat.total_s if lat.total_s else 0.0
             rows[tag] = {
                 "slots": slots,
                 "tensor_parallel": tp,
-                "prefill_tokens_per_s": round(stats.prefill_tokens_per_s, 1),
-                "decode_tokens_per_s": round(stats.decode_tokens_per_s, 1),
+                "prefill_tokens_per_s": round(prefill_tps, 1),
+                "decode_tokens_per_s": round(decode_tps, 1),
                 "decode_tokens_per_s_per_slot":
-                    round(stats.decode_tokens_per_s_per_slot, 2),
-                "decode_steps": stats.decode_steps,
+                    round(decode_tps / slots, 2),
+                "decode_steps": lat.steps,
                 "latency_ms": {"p50": round(lat.p50_ms, 2),
                                "p95": round(lat.p95_ms, 2),
                                "p99": round(lat.p99_ms, 2)},
-                "ttft_ms": {"p50": round(stats.ttft.p50_ms, 2),
-                            "p95": round(stats.ttft.p95_ms, 2)},
+                "ttft_ms": {"p50": round(ttft.p50_ms, 2),
+                            "p95": round(ttft.p95_ms, 2)},
             }
             measured += 1
             print(f"[serve_bench] {tag}: prefill "
-                  f"{stats.prefill_tokens_per_s:,.0f} tok/s, decode "
-                  f"{stats.decode_tokens_per_s_per_slot:.1f} tok/s/slot, "
+                  f"{prefill_tps:,.0f} tok/s, decode "
+                  f"{decode_tps / slots:.1f} tok/s/slot, "
                   f"p99 {lat.p99_ms:.1f}ms", file=sys.stderr)
 
     out = {

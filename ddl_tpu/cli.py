@@ -23,6 +23,7 @@ compat switches — see ddl_tpu.train.config.TrainConfig).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import sys
@@ -141,6 +142,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the training loop "
                         "into DIR (view in TensorBoard/Perfetto)")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write run telemetry as JSONL (ddl_tpu.obs registry "
+                        "snapshots — counters/gauges/histograms; the FIRST "
+                        "record is a run manifest with jax/jaxlib versions, "
+                        "mesh shape, config dump and git sha). On train/lm "
+                        "this also enables the in-graph health signals "
+                        "(grad norm, per-subtree param/update norms, "
+                        "non-finite counters)")
+    p.add_argument("--metrics-interval", type=int, default=None, metavar="N",
+                   help="fetch the in-graph health signals every N global "
+                        "steps (default 10; one batched device->host read "
+                        "at a span boundary — never a per-step sync); "
+                        "requires --metrics-out")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="capture a structured trace into DIR: host spans/"
+                        "request-lifecycle events as host_trace_p*.jsonl "
+                        "(convert to Chrome/Perfetto with 'python -m "
+                        "ddl_tpu.obs.trace in.jsonl out.json') PLUS the "
+                        "jax.profiler XLA timeline in the same directory")
     p.add_argument("--json", action="store_true",
                    help="emit a single JSON result line at exit")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
@@ -518,6 +538,33 @@ _SERVE_ONLY_DESTS = (
 )
 
 
+def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
+    """``(registry, writer, tracer)`` from the shared telemetry flags
+    (ISSUE 5) — ``None`` where off. The run manifest (versions, mesh
+    shape, config dump, git sha) is written as the metrics file's FIRST
+    record at construction, so even a crashed run leaves an attributable
+    artifact. ``make_tracer=False`` leaves the tracer to the caller
+    (the serve path builds its own via ``obs.trace.trace_context``,
+    which also scopes the jax.profiler trace; the trainers compose the
+    pieces directly because their profiler bracket must exclude AOT
+    compilation)."""
+    registry = writer = tracer = None
+    if args.metrics_out:
+        from .obs import MetricRegistry, MetricsWriter, run_manifest
+
+        registry = MetricRegistry()
+        writer = MetricsWriter(
+            args.metrics_out, registry,
+            run_manifest(config=config, mesh=mesh,
+                         extra={"variant": args.variant}),
+        )
+    if make_tracer and args.trace_dir:
+        from .obs.trace import Tracer, host_trace_file
+
+        tracer = Tracer(host_trace_file(args.trace_dir))
+    return registry, writer, tracer
+
+
 def _reject_foreign_flags(args, variant: str, dests) -> None:
     defaults = build_parser()
     for dest in dests:
@@ -624,17 +671,37 @@ def _run_lm(args) -> int:
         # a real runtime bug (corrupt checkpoint, JAX shape error) and
         # keeps its traceback (round-4 advisor).
         raise SystemExit(f"lm config error: {e}")
+    registry, writer, tracer = _build_obs(args, config=cfg, mesh=trainer.mesh)
     try:
         result = trainer.train(
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
-            profile_dir=args.profile,
+            # --trace-dir captures the XLA timeline alongside the host
+            # spans (an explicit --profile dir wins for the profiler).
+            profile_dir=args.profile or args.trace_dir,
             should_stop=lambda: term["flag"],
             dispatch_timeout=args.dispatch_timeout,
+            metrics=registry,
+            metrics_interval=args.metrics_interval,
+            metrics_writer=writer,
+            tracer=tracer,
         )
+        if registry is not None:
+            registry.gauge("train_final_accuracy").set(result.final_accuracy)
+            registry.gauge("train_run_tokens_per_sec").set(
+                result.tokens_per_sec
+            )
     except AcceleratorTimeout as e:
         return _fatal_timeout(e)
+    finally:
+        # Close on ANY exit path with a live interpreter, so a crashed
+        # run still ends with a forced final snapshot (the timeout path
+        # os._exits by contract — its backend is wedged in native code).
+        if tracer is not None:
+            tracer.close()
+        if writer is not None:
+            writer.close()
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.tokens_per_sec:.0f} tokens/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")
@@ -743,12 +810,28 @@ def _run_serve(args) -> int:
         Request(id=i, prompt=pr, max_new_tokens=args.max_new_tokens)
         for i, pr in enumerate(prompts)
     ]
-    scheduler = Scheduler(engine)
+    registry, writer, _ = _build_obs(
+        args, config=cfg, mesh=engine.mesh, make_tracer=False
+    )
+    scheduler = Scheduler(engine, registry=registry, metrics_writer=writer)
     # Compile outside the reported run: the printed/JSON latency
     # percentiles and tok/s must measure serving, not jit (the shared
-    # serve_bench/BASELINE.md methodology).
+    # serve_bench/BASELINE.md methodology). Warmup also suppresses
+    # telemetry, so the trace/metrics see only the reported run.
     scheduler.warmup(requests)
-    done, stats = scheduler.run(requests)
+    from .obs.trace import trace_context
+
+    try:
+        # --trace-dir: ONE context scopes both timelines — the host
+        # request-lifecycle spans and the jax.profiler XLA timeline
+        # land in the same directory for the same bracket (and the
+        # profiler starts only now, after warmup's compilation).
+        with trace_context(args.trace_dir) as tracer:
+            scheduler.tracer = tracer
+            done, stats = scheduler.run(requests)
+    finally:
+        if writer is not None:
+            writer.close()
     for i in sorted(done):
         c = done[i]
         print(f"request {i}: prompt {c.prompt_len} tokens -> "
@@ -794,6 +877,20 @@ def _run_serve(args) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.metrics_interval is not None:
+        if args.metrics_interval < 1:
+            raise SystemExit(
+                f"--metrics-interval must be >= 1, got "
+                f"{args.metrics_interval}"
+            )
+        if args.metrics_out is None:
+            # Same loud-fail hygiene as the variant flag groups: an
+            # interval without a sink would be silently ignored. The
+            # parser default is None (not 10) precisely so an EXPLICIT
+            # `--metrics-interval 10` cannot slip past this check.
+            raise SystemExit("--metrics-interval requires --metrics-out")
+    else:
+        args.metrics_interval = 10
     if args.platform:
         import jax
 
@@ -899,18 +996,53 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("--resume requires --checkpoint-dir")
     from .parallel.mesh import AcceleratorTimeout
 
+    registry, writer, tracer = _build_obs(
+        args, config=cfg, mesh=getattr(trainer, "mesh", None)
+    )
+    obs_kwargs = {}
+    run_span = contextlib.nullcontext()
+    if args.variant == "single":
+        # In-graph health + span tracing ride the single-chip trainer
+        # (train.trainer); the sync/async strategies report end-of-run
+        # summaries into the registry below (their span loops predate
+        # the obs layer — README Observability).
+        obs_kwargs = dict(
+            metrics=registry, metrics_interval=args.metrics_interval,
+            metrics_writer=writer, tracer=tracer,
+        )
+    elif tracer is not None:
+        # sync/async: the trainers take no tracer, but --trace-dir must
+        # still deliver the promised host_trace_p*.jsonl — one coarse
+        # run-level span wraps the whole training call.
+        run_span = tracer.span("train/run", variant=args.variant)
     term = _install_sigterm_flag(bool(args.checkpoint_dir))
     try:
-        result = trainer.train(
-            checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every,
-            resume=args.resume,
-            profile_dir=args.profile,
-            should_stop=lambda: term["flag"],
-            dispatch_timeout=args.dispatch_timeout,
-        )
+        with run_span:
+            result = trainer.train(
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume,
+                profile_dir=args.profile or args.trace_dir,
+                should_stop=lambda: term["flag"],
+                dispatch_timeout=args.dispatch_timeout,
+                **obs_kwargs,
+            )
+            if registry is not None:
+                registry.gauge("train_final_accuracy").set(
+                    result.final_accuracy
+                )
+                registry.gauge("train_run_images_per_sec").set(
+                    result.images_per_sec
+                )
     except AcceleratorTimeout as e:
         return _fatal_timeout(e)
+    finally:
+        # Any exit path with a live interpreter still forces a final
+        # snapshot (the timeout path os._exits by contract).
+        if tracer is not None:
+            tracer.close()
+        if writer is not None:
+            writer.close()
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")

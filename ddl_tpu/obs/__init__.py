@@ -1,0 +1,31 @@
+"""Unified telemetry (ISSUE 5): the observability layer every subsystem
+reports through.
+
+The reference's only observability is rank/epoch ``print``s and a
+``time.clock()`` wall bracket (SURVEY.md §5); production pjit/TPU stacks
+treat step-time breakdowns and per-request traces as first class
+(arXiv:2204.06514 §5; the serving comparisons of arXiv:2605.25645 are
+built entirely on such telemetry). Three pieces, one package:
+
+- :mod:`ddl_tpu.obs.trace` — nestable host wall-clock spans + instant
+  events, emitted as JSONL and convertible to a Chrome/Perfetto
+  ``trace_event`` file; ``trace_context`` wraps the existing
+  ``jax.profiler`` trace so one ``--trace-dir`` run captures both the
+  host span timeline and the XLA device timeline.
+- :mod:`ddl_tpu.obs.registry` — counters / gauges / histograms with
+  label sets, a JSONL snapshot writer (manifest-first), and a
+  Prometheus-text export. Replaces the ad-hoc per-subsystem stats
+  dicts as the machine-readable surface (``ServeStats`` et al. remain
+  as typed in-process views).
+- :mod:`ddl_tpu.obs.health` — in-graph training health signals
+  (global grad norm, per-subtree param/update norms, non-finite
+  gradient counts) computed INSIDE the jitted step bodies as an aux
+  output and fetched batched, so the hot path never gains a device
+  sync.
+
+Everything is surfaced by ``cli.py`` via ``--metrics-out``,
+``--metrics-interval`` and ``--trace-dir`` (README "Observability").
+"""
+
+from .registry import MetricRegistry, MetricsWriter, run_manifest  # noqa: F401
+from .trace import NULL_TRACER, Tracer, trace_context  # noqa: F401

@@ -1,0 +1,232 @@
+"""In-graph training health signals (ISSUE 5 tentpole piece 3).
+
+Computed INSIDE the jitted step bodies, right after each body's own
+explicit gradient reduction, and returned as a small dict of scalars —
+an aux output the trainer fetches BATCHED (a whole span's worth at
+once, only on spans crossing ``metrics_interval``), so enabling health
+never adds a per-step device sync and disabling it leaves the compiled
+step byte-identical (the flag is a Python-level branch).
+
+Signals:
+
+- ``grad_norm`` — global L2 norm of the fully-reduced gradient: the
+  same tensor a single-device ``jax.grad`` of the global weighted-mean
+  loss would produce (pinned against that oracle on the dp2 x tp2 mesh
+  in tests/test_obs.py).
+- ``nonfinite_grads`` — count of non-finite gradient ELEMENTS (int32):
+  the divergence tripwire; 0 on every healthy step.
+- ``param_norm`` / ``update_norm`` — global L2 norms of the params and
+  of this step's applied update (new - old), plus one
+  ``param_norm/<subtree>`` / ``update_norm/<subtree>`` pair per
+  top-level param subtree (LM: embed / blocks / lnf_g / lnf_b / head;
+  CNN: the per-variable names) — the update/param ratio per subtree is
+  the classic learning-rate health read.
+
+Cross-device correctness is PartitionSpec-driven: each leaf's local
+squared sum is ``psum``'d over exactly the mesh axes its spec names
+(tp-sharded Megatron leaves over tp, stage-resident pipeline stacks
+over pp, replicated leaves over nothing). Callers pass the same spec
+tree they place the params with, so the health math can never disagree
+with the placement. The ZeRO-1 flat-chunk paths use
+:func:`flat_grad_sq_nonfinite` instead — chunks are disjoint across
+the (dp, sp) devices, so one psum of the local chunk's squared sum IS
+the global value (padding contributes zero).
+
+The dict's key set is a static function of the param template
+(:func:`health_keys`), so ``shard_map`` out_specs and scan carries are
+knowable without tracing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _spec_axes(spec) -> tuple:
+    """Every mesh axis named anywhere in ``spec`` (deduped, stable)."""
+    if not isinstance(spec, P):
+        return ()
+    axes = []
+    for part in spec:
+        if part is None:
+            continue
+        parts = part if isinstance(part, (tuple, list)) else (part,)
+        axes.extend(a for a in parts if a is not None)
+    return tuple(dict.fromkeys(axes))
+
+
+def _top_key(path) -> str:
+    """Top-level subtree label of a ``tree_leaves_with_path`` path."""
+    k = path[0]
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _leaves_with_specs(tree, pspecs):
+    """``[(subtree, leaf, spec_axes)]`` with the spec tree flattened in
+    the SAME leaf order as the value tree. ``pspecs`` may be a single
+    ``P()`` / None (the tp=1 broadcast form) — every leaf then shares
+    it."""
+    named = jax.tree_util.tree_leaves_with_path(tree)
+    if pspecs is None or isinstance(pspecs, P):
+        spec = pspecs if isinstance(pspecs, P) else P()
+        specs = [spec] * len(named)
+    else:
+        specs = jax.tree.flatten(
+            pspecs, is_leaf=lambda s: isinstance(s, P)
+        )[0]
+        if len(specs) != len(named):
+            raise ValueError(
+                f"param/spec tree mismatch: {len(named)} leaves vs "
+                f"{len(specs)} specs"
+            )
+    return [
+        (_top_key(path), leaf, _spec_axes(spec))
+        for (path, leaf), spec in zip(named, specs)
+    ]
+
+
+def _grouped_sq(entries) -> dict[str, jax.Array]:
+    """Per-subtree global sum of squares: local sums grouped by
+    (subtree, psum axes) so each group pays ONE scalar psum, not one
+    per leaf."""
+    local: dict[tuple[str, tuple], jax.Array] = {}
+    for key, leaf, axes in entries:
+        sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        gk = (key, axes)
+        local[gk] = local.get(gk, jnp.float32(0.0)) + sq
+    out: dict[str, jax.Array] = {}
+    for (key, axes), sq in local.items():
+        if axes:
+            sq = lax.psum(sq, axes)
+        out[key] = out.get(key, jnp.float32(0.0)) + sq
+    return out
+
+
+def subtree_keys(template) -> list[str]:
+    """Sorted top-level subtree labels of a param tree (static — works
+    on shapes-only templates AND on PartitionSpec trees: a P is a tuple
+    subclass, so it must be treated as a leaf, not flattened into)."""
+    return sorted({
+        _top_key(path)
+        for path, _ in jax.tree_util.tree_leaves_with_path(
+            template, is_leaf=lambda x: isinstance(x, P)
+        )
+    })
+
+
+def health_keys(template) -> list[str]:
+    """The static key set of :func:`health_signals` for this param
+    template — what shard_map out_specs / scan carries are built from."""
+    keys = ["grad_norm", "nonfinite_grads", "param_norm", "update_norm"]
+    for k in subtree_keys(template):
+        keys.append(f"param_norm/{k}")
+        keys.append(f"update_norm/{k}")
+    return keys
+
+
+def health_out_specs(template) -> dict:
+    """``shard_map`` out_specs for the health dict: every signal is
+    fully reduced (replicated) by construction."""
+    return {k: P() for k in health_keys(template)}
+
+
+def grad_signals(grads, pspecs) -> dict[str, jax.Array]:
+    """``grad_norm`` + ``nonfinite_grads`` from a FULL gradient tree
+    whose leaves are complete up to the sharding ``pspecs`` describes
+    (i.e. after the step body's explicit data-axis reduction)."""
+    entries = _leaves_with_specs(grads, pspecs)
+    total = jnp.float32(0.0)
+    for sq in _grouped_sq(entries).values():
+        total = total + sq
+    nf_local: dict[tuple, jax.Array] = {}
+    for _, leaf, axes in entries:
+        n = jnp.sum(~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.int32)
+        nf_local[axes] = nf_local.get(axes, jnp.int32(0)) + n
+    nf = jnp.int32(0)
+    for axes, n in nf_local.items():
+        nf = nf + (lax.psum(n, axes) if axes else n)
+    return {"grad_norm": jnp.sqrt(total), "nonfinite_grads": nf}
+
+
+def norm_signals(params, new_params, pspecs) -> dict[str, jax.Array]:
+    """Global + per-subtree param and update (new - old) L2 norms.
+    Subtree keys are emitted in sorted order so the dict structure is
+    identical across step-body modes (scan/stacking relies on it)."""
+    updates = jax.tree.map(
+        lambda a, b: b.astype(jnp.float32) - a.astype(jnp.float32),
+        params, new_params,
+    )
+    p_sub = _grouped_sq(_leaves_with_specs(params, pspecs))
+    u_sub = _grouped_sq(_leaves_with_specs(updates, pspecs))
+    out = {
+        "param_norm": jnp.sqrt(sum(p_sub.values(), jnp.float32(0.0))),
+        "update_norm": jnp.sqrt(sum(u_sub.values(), jnp.float32(0.0))),
+    }
+    for k in sorted(p_sub):
+        out[f"param_norm/{k}"] = jnp.sqrt(p_sub[k])
+        out[f"update_norm/{k}"] = jnp.sqrt(u_sub[k])
+    return out
+
+
+def health_signals(grads, params, new_params, pspecs) -> dict[str, jax.Array]:
+    """The full signal dict (see module docstring); key set ==
+    :func:`health_keys` of the param template."""
+    out = grad_signals(grads, pspecs)
+    out.update(norm_signals(params, new_params, pspecs))
+    return {k: out[k] for k in health_keys(params)}
+
+
+def record_health(registry, hstack, *, prefix: str = "train",
+                  include_nonfinite: bool = True) -> None:
+    """Record a fetched ``[k]``-stacked health dict (one span's steps)
+    into the registry: the LAST step's values as gauges (per-subtree
+    norms as ``subtree``-labelled series of the same metric name; the
+    unlabelled series is the global norm) and the span's total
+    non-finite element count onto ``<prefix>_nonfinite_grads_total``.
+    ``include_nonfinite=False`` skips the counter — for trainers that
+    feed it separately from EVERY span (the tripwire must never skip a
+    step, while the norm gauges are interval-sampled)."""
+    import numpy as np
+
+    hs = {k: np.asarray(v) for k, v in hstack.items()}
+    nf = hs.pop("nonfinite_grads")
+    if include_nonfinite:
+        record_nonfinite(registry, nf, prefix=prefix)
+    for key, arr in hs.items():
+        v = float(arr[-1])
+        if "/" in key:
+            base, sub = key.split("/", 1)
+            registry.gauge(f"{prefix}_{base}").set(v, subtree=sub)
+        else:
+            registry.gauge(f"{prefix}_{key}").set(v)
+
+
+def record_nonfinite(registry, nf_stack, *, prefix: str = "train") -> None:
+    """Add one span's ``[k]``-stacked non-finite element counts to the
+    divergence-tripwire counter. Trainers call this for EVERY span (the
+    array is a handful of int32s riding the already-synced span
+    boundary), so a NaN burst can never fall between metrics
+    intervals."""
+    import numpy as np
+
+    registry.counter(
+        f"{prefix}_nonfinite_grads_total",
+        "non-finite gradient elements seen (divergence tripwire)",
+    ).inc(int(np.asarray(nf_stack).sum()))
+
+
+def flat_grad_sq_nonfinite(g_own, axes) -> tuple[jax.Array, jax.Array]:
+    """(global squared sum, global non-finite count) of a ZeRO-1 flat
+    gradient CHUNK: chunks are disjoint across the devices of ``axes``
+    and cover the whole gradient (padding is zeros), so one psum of
+    the local values is the global answer."""
+    g = g_own.astype(jnp.float32)
+    sq = lax.psum(jnp.sum(jnp.square(g)), axes)
+    nf = lax.psum(jnp.sum(~jnp.isfinite(g)).astype(jnp.int32), axes)
+    return sq, nf

@@ -1,0 +1,328 @@
+"""Metric registry: counters / gauges / histograms with label sets.
+
+The machine-readable metrics surface every subsystem reports through
+(ISSUE 5) — replacing the ad-hoc per-run stats dicts as the thing
+benchmarks and dashboards read. Three metric kinds, Prometheus
+semantics:
+
+- **Counter** — monotonically increasing total (``inc``); negative
+  increments are rejected.
+- **Gauge** — last-written value (``set``).
+- **Histogram** — raw observed samples per label set. Percentiles are
+  computed from the RAW samples with exactly the
+  ``StepStats.from_times`` definition (``stats()`` literally delegates
+  to it), so a registry histogram of step durations and a
+  ``StepTimer`` of the same brackets can never disagree — the parity
+  is pinned in tests/test_obs.py.
+
+Label sets: each distinct ``**labels`` dict (order-insensitive, values
+stringified) is an independent series under the metric name, exactly
+Prometheus's data model. Registering one name as two kinds is an error.
+
+Two exports:
+
+- :meth:`MetricRegistry.prometheus_text` — a text-format snapshot
+  (counters/gauges verbatim; histograms as summaries with
+  p50/p95/p99 quantile rows plus ``_count``/``_sum``).
+- :class:`MetricsWriter` — the JSONL sink behind ``--metrics-out``:
+  the FIRST record of every file is a run manifest
+  (:func:`run_manifest` — jax/jaxlib versions, mesh shape, config
+  dump, git sha), then one snapshot record per flush; flushes are
+  rate-limited by ``interval_s`` and forced on ``close()``/exit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from ..utils.metrics import StepStats
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "?"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._series: dict[tuple, object] = {}
+
+    def label_sets(self) -> list[dict]:
+        return [dict(k) for k in self._series]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1, **labels) -> None:
+        if value < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc {value}); "
+                "use a gauge for values that go down"
+            )
+        k = _label_key(labels)
+        self._series[k] = self._series.get(k, 0) + value
+
+    def value(self, **labels):
+        return self._series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._series[_label_key(labels)] = value
+
+    def value(self, **labels):
+        return self._series.get(_label_key(labels))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def observe(self, value: float, **labels) -> None:
+        self._series.setdefault(_label_key(labels), []).append(float(value))
+
+    def observe_many(self, values, **labels) -> None:
+        self._series.setdefault(_label_key(labels), []).extend(
+            float(v) for v in values
+        )
+
+    def values(self, **labels) -> list[float]:
+        return list(self._series.get(_label_key(labels), ()))
+
+    def count(self, **labels) -> int:
+        return len(self._series.get(_label_key(labels), ()))
+
+    def percentile(self, q: float, **labels) -> float:
+        """Raw-unit percentile over the observed samples —
+        ``np.percentile``'s linear interpolation, the SAME definition
+        ``StepStats.from_times`` uses (parity pinned in test_obs)."""
+        vals = self._series.get(_label_key(labels))
+        if not vals:
+            return 0.0
+        return float(np.percentile(np.asarray(vals, np.float64), q))
+
+    def stats(self, **labels) -> StepStats:
+        """The observed samples as a ``StepStats`` (ms percentiles for
+        second-valued observations) — DELEGATES to
+        ``StepStats.from_times`` so the two percentile surfaces are one
+        computation."""
+        return StepStats.from_times(self.values(**labels))
+
+
+class MetricRegistry:
+    """Name -> metric map with kind checking. ``counter``/``gauge``/
+    ``histogram`` create on first use and return the existing instance
+    after (same-name re-registration with a different kind raises)."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, help)
+        elif type(m) is not cls:
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    def metrics(self) -> list[_Metric]:
+        return [self._metrics[n] for n in sorted(self._metrics)]
+
+    # -- exports -----------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """One plain-dict record per (metric, label set). Counters and
+        gauges carry ``value``; histograms carry count/sum/mean and
+        raw-unit p50/p95/p99 (linear interpolation — the from_times
+        definition)."""
+        out = []
+        for m in self.metrics():
+            for lk in sorted(m._series):
+                rec = {"name": m.name, "kind": m.kind, "labels": dict(lk)}
+                state = m._series[lk]
+                if m.kind == "histogram":
+                    a = np.asarray(state, np.float64)
+                    rec.update(
+                        count=int(a.size),
+                        sum=float(a.sum()),
+                        mean=float(a.mean()) if a.size else 0.0,
+                        p50=float(np.percentile(a, 50)) if a.size else 0.0,
+                        p95=float(np.percentile(a, 95)) if a.size else 0.0,
+                        p99=float(np.percentile(a, 99)) if a.size else 0.0,
+                    )
+                else:
+                    rec["value"] = state
+                out.append(rec)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of the current state (histograms
+        as summaries: quantile rows + ``_sum``/``_count``)."""
+
+        def fmt_labels(labels: dict, extra: dict | None = None) -> str:
+            items = {**labels, **(extra or {})}
+            if not items:
+                return ""
+            body = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+            return "{" + body + "}"
+
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            kind = "summary" if m.kind == "histogram" else m.kind
+            lines.append(f"# TYPE {m.name} {kind}")
+            for lk in sorted(m._series):
+                labels = dict(lk)
+                state = m._series[lk]
+                if m.kind == "histogram":
+                    a = np.asarray(state, np.float64)
+                    for q in (0.5, 0.95, 0.99):
+                        v = float(np.percentile(a, q * 100)) if a.size else 0.0
+                        lines.append(
+                            f"{m.name}{fmt_labels(labels, {'quantile': q})}"
+                            f" {v}"
+                        )
+                    lines.append(
+                        f"{m.name}_sum{fmt_labels(labels)} {float(a.sum())}"
+                    )
+                    lines.append(
+                        f"{m.name}_count{fmt_labels(labels)} {int(a.size)}"
+                    )
+                else:
+                    lines.append(f"{m.name}{fmt_labels(labels)} {state}")
+        return "\n".join(lines) + "\n"
+
+
+# -- run manifest ------------------------------------------------------------
+
+
+def _git_sha() -> str | None:
+    try:
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, timeout=5,
+            capture_output=True, text=True,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:  # noqa: BLE001 — no git is a fine answer
+        return None
+
+
+def run_manifest(config=None, mesh=None, extra: dict | None = None) -> dict:
+    """Reproducibility header for a metrics file: versions, topology,
+    config, git sha. Every field degrades to None instead of raising —
+    a manifest must never be the thing that kills a run."""
+    man: dict = {"schema": "ddl_tpu.metrics.v1"}
+    try:
+        import jax
+        import jaxlib
+
+        man["jax_version"] = jax.__version__
+        man["jaxlib_version"] = jaxlib.__version__
+        try:
+            devs = jax.devices()
+            man["platform"] = devs[0].platform
+            man["device_count"] = len(devs)
+            man["process_index"] = int(jax.process_index())
+        except RuntimeError:
+            man["platform"] = None
+    except Exception:  # noqa: BLE001
+        man["jax_version"] = None
+    if mesh is not None:
+        man["mesh_shape"] = {
+            str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        }
+    if config is not None:
+        man["config"] = (
+            dataclasses.asdict(config)
+            if dataclasses.is_dataclass(config) else config
+        )
+    man["git_sha"] = _git_sha()
+    man["pid"] = os.getpid()
+    man["argv"] = list(sys.argv)
+    man["python"] = sys.version.split()[0]
+    man["t_wall"] = time.time()
+    if extra:
+        man.update(extra)
+    return man
+
+
+class MetricsWriter:
+    """The JSONL sink behind ``--metrics-out``: manifest record first
+    (``{"record": "manifest", ...}``), then one
+    ``{"record": "snapshot", "t_wall", "t_mono", "metrics": [...]}``
+    per flush. ``maybe_flush()`` is rate-limited by ``interval_s`` (the
+    trainer/scheduler loops call it freely); ``flush(force=True)`` and
+    ``close()`` always write, so the file ends with a complete final
+    state on any clean exit path."""
+
+    def __init__(self, path, registry: MetricRegistry, manifest: dict |
+                 None = None, *, interval_s: float = 10.0):
+        self.registry = registry
+        self.interval_s = interval_s
+        self._last = float("-inf")
+        path = os.fspath(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._file = open(path, "w")
+        rec = {"record": "manifest", **(manifest or run_manifest())}
+        self._file.write(json.dumps(rec) + "\n")
+        self._file.flush()
+
+    def maybe_flush(self, force: bool = False) -> bool:
+        if self._file is None:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last < self.interval_s:
+            return False
+        self._last = now
+        self._file.write(json.dumps({
+            "record": "snapshot",
+            "t_wall": time.time(),
+            "t_mono": time.perf_counter(),
+            "metrics": self.registry.snapshot(),
+        }) + "\n")
+        self._file.flush()
+        return True
+
+    def close(self) -> None:
+        if self._file is not None:
+            self.maybe_flush(force=True)
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

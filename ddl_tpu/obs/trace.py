@@ -1,0 +1,269 @@
+"""Structured span tracing: nestable host wall-clock spans as JSONL.
+
+One record per line, so a trace survives crashes mid-run (every
+completed span is already on disk) and concatenates across processes.
+Each record carries BOTH clocks — ``t``/``t0`` are ``time.perf_counter``
+(monotonic; all intra-run math uses these) and ``t_wall`` is
+``time.time`` (correlation across hosts/files) — plus ``pid`` and the
+JAX ``process_index`` so multi-process worlds merge cleanly.
+
+Two record types::
+
+    {"type": "span",  "name": ..., "t0": ..., "t": ..., "dur_s": ...,
+     "depth": ..., "seq": ..., "pid": ..., "process_index": ...,
+     "t_wall": ..., "attrs": {...}}
+    {"type": "event", "name": ..., "t": ..., "depth": ..., ...}
+
+Spans nest (``depth`` is the span's own nesting level; records are
+emitted at span END, so a child's record precedes its parent's — order
+by ``t0``/``seq`` to reconstruct the tree). ``event`` accepts an
+explicit ``t`` so callers can stamp an event with the exact
+``perf_counter`` value they used for their own derived metrics — the
+serve scheduler does this, which is what makes span-derived TTFT/ITL
+EXACTLY equal to ``ServeStats`` (tests/test_obs.py).
+
+``chrome_trace_events`` converts records to the Chrome/Perfetto
+``trace_event`` format; ``python -m ddl_tpu.obs.trace in.jsonl out.json``
+converts a file (open the result at https://ui.perfetto.dev or
+chrome://tracing). ``trace_context`` combines a host tracer with the
+existing ``jax.profiler`` trace (utils.metrics.trace), so a single
+``--trace-dir`` run captures the host span timeline AND the XLA device
+timeline side by side.
+
+``NULL_TRACER`` is the disabled instance: same API, no records, and
+FALSY — call sites guard clock reads with ``if tracer:`` so a disabled
+run does not even pay the ``perf_counter`` calls (the off-path-unchanged
+acceptance bar).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+
+def _process_index() -> int:
+    """JAX process index, 0 when no backend is reachable. Called lazily
+    at first emit / context entry — never at import — so constructing a
+    tracer can never initialize a backend before the CLI configures the
+    platform."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no backend is a fine answer
+        return 0
+
+
+class Tracer:
+    """JSONL span/event emitter. ``path=None`` keeps records in memory
+    only (``self.records`` — the test/derivation surface); with a path,
+    records stream to disk and are ALSO kept when ``keep=True``."""
+
+    def __init__(self, path: str | os.PathLike | None = None, *,
+                 keep: bool | None = None):
+        self._path = os.fspath(path) if path is not None else None
+        self._file = None
+        self._keep = keep if keep is not None else self._path is None
+        self.records: list[dict] = []
+        self._depth = 0
+        self._seq = 0
+        self._pid = os.getpid()
+        self._pindex: int | None = None
+
+    def __bool__(self) -> bool:
+        return True
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        if self._pindex is None:
+            self._pindex = _process_index()
+        rec["seq"] = self._seq
+        self._seq += 1
+        rec["pid"] = self._pid
+        rec["process_index"] = self._pindex
+        rec["t_wall"] = time.time()
+        if self._keep:
+            self.records.append(rec)
+        if self._path is not None:
+            if self._file is None:
+                parent = os.path.dirname(self._path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                # "w", matching MetricsWriter: a rerun into the same
+                # --trace-dir replaces the old trace — appending would
+                # interleave two runs' unrelated monotonic clocks in
+                # the Chrome conversion. Crash-safety is unaffected
+                # (records still stream line by line).
+                self._file = open(self._path, "w")
+            self._file.write(json.dumps(rec) + "\n")
+
+    def event(self, name: str, t: float | None = None, **attrs) -> None:
+        """Instant event. ``t`` (``perf_counter`` seconds) defaults to
+        now; pass it explicitly to stamp the event with a timestamp you
+        also used elsewhere (exact-derivation contract, module doc)."""
+        self._emit({
+            "type": "event", "name": name,
+            "t": time.perf_counter() if t is None else t,
+            "depth": self._depth, "attrs": attrs,
+        })
+
+    def complete(self, name: str, t0: float, t1: float, **attrs) -> None:
+        """A finished span with caller-supplied bracket timestamps."""
+        self._emit({
+            "type": "span", "name": name, "t0": t0, "t": t1,
+            "dur_s": t1 - t0, "depth": self._depth, "attrs": attrs,
+        })
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Nestable wall-clock span; the record is emitted at exit (so
+        an exception inside still leaves the span on disk)."""
+        t0 = time.perf_counter()
+        self._depth += 1
+        try:
+            yield self
+        finally:
+            self._depth -= 1
+            self.complete(name, t0, time.perf_counter(), **attrs)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullTracer:
+    """Disabled tracer: same API, records nothing, and FALSY so call
+    sites can skip even their clock reads (``if tracer: ...``)."""
+
+    records: tuple = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def event(self, name: str, t: float | None = None, **attrs) -> None:
+        pass
+
+    def complete(self, name: str, t0: float, t1: float, **attrs) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        yield self
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+def host_trace_file(trace_dir: str | os.PathLike) -> str:
+    """The per-process host-span JSONL path inside ``trace_dir``
+    (created): ``host_trace_p<process_index>.jsonl`` — one file per
+    controller, mergeable by concatenation."""
+    trace_dir = os.fspath(trace_dir)
+    os.makedirs(trace_dir, exist_ok=True)
+    return os.path.join(trace_dir, f"host_trace_p{_process_index()}.jsonl")
+
+
+@contextlib.contextmanager
+def trace_context(trace_dir: str | os.PathLike | None):
+    """Host tracer + ``jax.profiler`` trace in one directory (None =
+    disabled: yields ``NULL_TRACER``, starts nothing). The host spans
+    land in ``host_trace_p<process_index>.jsonl`` next to the XLA
+    profile, so one ``--trace-dir`` run captures both timelines."""
+    if trace_dir is None:
+        yield NULL_TRACER
+        return
+    trace_dir = os.fspath(trace_dir)
+    tracer = Tracer(host_trace_file(trace_dir))
+    from ..utils.metrics import trace as profiler_trace
+
+    try:
+        with profiler_trace(trace_dir):
+            yield tracer
+    finally:
+        tracer.close()
+
+
+# -- Chrome/Perfetto conversion ---------------------------------------------
+
+
+def chrome_trace_events(records) -> list[dict]:
+    """Tracer records -> Chrome ``trace_event`` list (``ph``="X"
+    complete events for spans, "i" instants for events; timestamps in
+    microseconds of the monotonic clock). Wrap in
+    ``{"traceEvents": [...]}`` or pass through :func:`convert`."""
+    out = []
+    for r in records:
+        base = {
+            "name": r["name"],
+            "pid": r.get("pid", 0),
+            "tid": r.get("process_index", 0),
+            "args": r.get("attrs", {}),
+        }
+        if r.get("type") == "span":
+            out.append({**base, "ph": "X", "ts": r["t0"] * 1e6,
+                        "dur": r["dur_s"] * 1e6})
+        else:
+            out.append({**base, "ph": "i", "ts": r["t"] * 1e6, "s": "t"})
+    return sorted(out, key=lambda e: (e["ts"], e["name"]))
+
+
+def read_jsonl(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def convert(src, dst) -> int:
+    """JSONL trace file -> Chrome ``trace_event`` JSON file; returns the
+    event count."""
+    events = chrome_trace_events(read_jsonl(src))
+    with open(dst, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Convert a ddl_tpu host-trace JSONL file to a "
+                    "Chrome/Perfetto trace_event JSON file "
+                    "(open at https://ui.perfetto.dev)"
+    )
+    ap.add_argument("src", help="host_trace_p*.jsonl input")
+    ap.add_argument("dst", help="trace_event JSON output")
+    args = ap.parse_args(argv)
+    n = convert(args.src, args.dst)
+    # sys.stdout.write, not print: library code routes through the
+    # tracer/registry — tests/test_no_stray_prints.py enforces it, and
+    # this one-line converter report is not worth an exemption.
+    import sys
+
+    sys.stdout.write(f"[obs.trace] wrote {n} trace events to {args.dst}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -123,7 +123,8 @@ def make_stage_fn(config, platform):
     return stage_fn, blocks_fwd
 
 
-def make_pipeline_step_body(config, part, tables, platform, *, lr):
+def make_pipeline_step_body(config, part, tables, platform, *, lr,
+                            health: bool = False):
     """One pipeline train step, already inside ``shard_map``
     (``check_vma=False``, local-grads mode):
     ``(params, opt, tokens, targets, weights) -> (params, opt, loss)``.
@@ -244,8 +245,23 @@ def make_pipeline_step_body(config, part, tables, platform, *, lr):
                 else jax.tree.map(lambda a: lax.psum(a, AXES), g))
             for k, g in gacc.items()
         }
-        params, opt_state = adam_update(params, opt_state, grads, lr=lr)
-        return params, opt_state, loss
+        new_params, new_opt = adam_update(params, opt_state, grads, lr=lr)
+        if not health:
+            return new_params, new_opt, loss
+        # In-graph health (obs.health, ISSUE 5): the stacked-block
+        # leaves are stage-resident over pp (and Megatron-sharded over
+        # tp), so their squared sums reduce over exactly the axes their
+        # PartitionSpec names; the pp-replicated shared leaves are
+        # already fully reduced. Python-level flag: health=False
+        # compiles the exact pre-observability program.
+        from ..models.partition import pipeline_param_specs
+        from ..obs import health as hlt
+
+        pspecs = pipeline_param_specs(
+            config.spec, part.pp, config.tensor_parallel
+        )
+        h = hlt.health_signals(grads, params, new_params, pspecs)
+        return new_params, new_opt, loss, h
 
     return step
 

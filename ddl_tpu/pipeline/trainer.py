@@ -31,30 +31,38 @@ from .schedule import schedule_tables
 from .step import make_pipeline_eval_body, make_pipeline_step_body
 
 
-def pipeline_shard_step(config, mesh, platform):
+def pipeline_shard_step(config, mesh, platform, health: bool = False):
     """The ``shard_map``'d pipeline train step for this config on this
     4-D mesh: ``(params, opt, tokens, targets, weights) ->
     (params, opt, loss)`` with train batches ``P(dp, sp)`` (sp is size
     1), the stacked param tree ``P(pp, ...)``-sharded, and optimizer
     state placed like the params. ``check_vma=False`` — local-grads
-    mode, every reduction explicit in the body (pipeline.step)."""
+    mode, every reduction explicit in the body (pipeline.step).
+    ``health=True`` appends the in-graph health dict (``obs.health``)
+    as a fourth, fully-reduced output."""
     part = stage_partition(config.spec, config.pipeline_parallel)
     tables = schedule_tables(
         config.pipeline_schedule, part.pp, config.microbatches
     )
     body = make_pipeline_step_body(
-        config, part, tables, platform, lr=config.learning_rate
+        config, part, tables, platform, lr=config.learning_rate,
+        health=health,
     )
     pspecs = pipeline_param_specs(
         config.spec, part.pp, config.tensor_parallel
     )
     opt_spec = AdamState(step=P(), m=pspecs, v=pspecs)
     seq = P(DP_AXIS, SP_AXIS)
+    out_specs = (pspecs, opt_spec, P())
+    if health:
+        from ..obs import health as hlt
+
+        out_specs = out_specs + (hlt.health_out_specs(pspecs),)
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(pspecs, opt_spec, seq, seq, seq),
-        out_specs=(pspecs, opt_spec, P()),
+        out_specs=out_specs,
         check_vma=False,
     )
 
@@ -77,7 +85,8 @@ def pipeline_shard_eval(config, mesh, platform, data_spec):
     )
 
 
-def make_pipeline_program(config, tokens, targets, weights):
+def make_pipeline_program(config, tokens, targets, weights,
+                          health: bool = False):
     """Standalone compiled pipeline step on a FRESH ``dp x 1 x tp x pp``
     mesh — the benchmark/audit entry point (bypasses SeqTrainer, so a
     ``microbatches=1`` config — rejected by ``validate_topology`` for
@@ -90,7 +99,7 @@ def make_pipeline_program(config, tokens, targets, weights):
         config.tensor_parallel, config.pipeline_parallel,
     )
     platform = mesh.devices.flat[0].platform
-    shard_step = pipeline_shard_step(config, mesh, platform)
+    shard_step = pipeline_shard_step(config, mesh, platform, health=health)
     host = jax.tree.map(
         np.asarray,
         transformer.init_lm_params(

@@ -19,7 +19,13 @@ Quickstart (also ``python -m ddl_tpu serve --help``)::
 
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .prefix import PrefixIndex  # noqa: F401
-from .scheduler import Completion, Request, Scheduler, ServeStats  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Completion,
+    Request,
+    Scheduler,
+    ServeStats,
+    derive_request_slo,
+)
 
 __all__ = [
     "Completion",
@@ -29,4 +35,5 @@ __all__ = [
     "Scheduler",
     "ServeConfig",
     "ServeStats",
+    "derive_request_slo",
 ]

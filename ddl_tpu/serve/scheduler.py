@@ -43,6 +43,24 @@ p50/p95/p99, TTFT (wall clock from arrival-eligibility to first
 token), ITL (gap between consecutive decode completions while slots
 stayed active — the stall chunking bounds), and prefix-cache
 hit-rate / prefill-tokens-saved.
+
+Telemetry (ISSUE 5): constructed with an ``obs.Tracer``, the scheduler
+emits the full request lifecycle as events/spans —
+``submit -> eligible -> admit -> prefix_copy -> prefill_chunk ->
+first_token -> decode_tick -> complete`` — each stamped with the SAME
+``perf_counter`` values the ``ServeStats`` math uses, so
+:func:`derive_request_slo` recovers TTFT/ITL from the trace EXACTLY
+equal to ``ServeStats.ttft``/``.itl`` (pinned at tp=1 and tp=2 in
+tests/test_obs.py). With an ``obs.MetricRegistry``, the scheduler
+keeps counters (prefill/decode tokens, prefix ledger, completions),
+per-tick gauges (queue depth, active/occupied slots, prefix-pool
+entries) and latency histograms (ttft / itl / decode step / prefill)
+— observed from the same brackets as the ``StepTimer``s, so the two
+surfaces can never disagree. ``warmup`` suppresses both (compile
+traffic must not pollute a run's telemetry). Both default off, and
+every clock read they add is gated on the tracer/registry being
+present — a bare ``Scheduler(engine)`` runs the exact
+pre-observability tick loop.
 """
 
 from __future__ import annotations
@@ -53,6 +71,7 @@ import time
 
 import numpy as np
 
+from ..obs.trace import NULL_TRACER
 from ..utils.metrics import StepStats, StepTimer
 from .engine import InferenceEngine
 
@@ -137,10 +156,19 @@ class Scheduler:
     at submit, naming the request)."""
 
     def __init__(self, engine: InferenceEngine, *, eos_id: int | None = None,
-                 allow_window: bool = False):
+                 allow_window: bool = False, tracer=None, registry=None,
+                 metrics_writer=None):
         self.engine = engine
         self.eos_id = eos_id
         self.allow_window = allow_window
+        # Telemetry (module docstring): request-lifecycle tracer,
+        # metric registry and (rate-limited) JSONL snapshot writer, all
+        # optional and all suppressed during warmup. NULL_TRACER is
+        # falsy, so `if self.tracer:` guards even the extra clock reads
+        # off the disabled path.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = registry
+        self.metrics_writer = metrics_writer
 
     def warmup(self, requests) -> None:
         """Compile the decode program and every prefill bucket / prefix
@@ -160,13 +188,23 @@ class Scheduler:
         if not requests:
             return
         eng = self.engine
-        self.run([
-            dataclasses.replace(
-                r, id=-1 - i,
-                max_new_tokens=min(2, r.max_new_tokens),
-            )
-            for i, r in enumerate(requests)
-        ])
+        # Compile traffic must not pollute the run's telemetry: the
+        # clone run emits no lifecycle events and moves no counters
+        # (the derived-TTFT pin would otherwise see the warmup's
+        # negative-id requests).
+        saved = self.tracer, self.registry, self.metrics_writer
+        self.tracer, self.registry, self.metrics_writer = \
+            NULL_TRACER, None, None
+        try:
+            self.run([
+                dataclasses.replace(
+                    r, id=-1 - i,
+                    max_new_tokens=min(2, r.max_new_tokens),
+                )
+                for i, r in enumerate(requests)
+            ])
+        finally:
+            self.tracer, self.registry, self.metrics_writer = saved
         max_bucket = eng.prefill_bucket(max(
             int(np.asarray(r.prompt).shape[0]) for r in requests
         ))
@@ -229,6 +267,15 @@ class Scheduler:
         ids = [r.id for r in requests]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate request ids in {ids}")
+        if self.tracer:
+            t_sub = time.perf_counter()
+            for r in requests:
+                self.tracer.event(
+                    "submit", t=t_sub, req=int(r.id),
+                    prompt_len=int(np.asarray(r.prompt).shape[0]),
+                    arrival=int(r.arrival),
+                    max_new_tokens=int(r.max_new_tokens),
+                )
         eng = self.engine
         S = eng.config.slots
         pending = collections.deque(
@@ -280,6 +327,8 @@ class Scheduler:
         eng = self.engine
         cfg = eng.config
         S = cfg.slots
+        tr = self.tracer
+        reg = self.registry
         chunk = cfg.prefill_chunk
         # Unset budget defaults to ONE chunk per tick — maximum decode
         # interleaving; chunking with an unmetered tick would run every
@@ -303,6 +352,12 @@ class Scheduler:
             if held_entry[s] >= 0:
                 eng.prefix_release(held_entry[s])
                 held_entry[s] = -1
+            if tr:
+                # Completion IS the eviction: the slot frees here.
+                tr.event("complete", req=int(r.id), slot=s, step=step,
+                         tokens=len(generated[s]))
+            if reg is not None:
+                reg.counter("serve_requests_completed_total").inc()
 
         def finished(s: int, token: int) -> bool:
             return (len(generated[s]) >= occupant[s].max_new_tokens
@@ -316,7 +371,12 @@ class Scheduler:
             for r in pending:
                 if r.arrival > step:
                     break  # pending is (arrival, id)-sorted
-                eligible_wall.setdefault(r.id, now)
+                if r.id not in eligible_wall:
+                    eligible_wall[r.id] = now
+                    if tr:
+                        # Stamped with the SAME `now` the TTFT clock
+                        # starts from — the derived-TTFT exactness pin.
+                        tr.event("eligible", t=now, req=int(r.id), step=step)
             # Admit: claim every free slot whose turn has come. With the
             # prefix cache, admission itself is only the (optional) row
             # copy — prompt compute happens in the prefill phase below.
@@ -331,12 +391,19 @@ class Scheduler:
                 admitted_at[s] = step
                 base = 0
                 store_after[s] = False
+                if tr:
+                    tr.event("admit", req=int(r.id), slot=s, step=step)
                 if eng.prefix is not None:
                     lookups += 1
                     entry, full = eng.prefix.match(r.prompt)
                     hit = min(full, p - 1)
                     if hit >= MIN_PREFIX_HIT:
+                        t0 = time.perf_counter() if tr else 0.0
                         eng.prefix_fetch(entry, hit, s)
+                        if tr:
+                            tr.complete("prefix_copy", t0,
+                                        time.perf_counter(),
+                                        req=int(r.id), slot=s, rows=hit)
                         held_entry[s] = entry
                         base = hit
                         hits += 1
@@ -374,10 +441,22 @@ class Scheduler:
                     if budget0 and budget < n:
                         break  # out of tick budget; resume next tick
                     base = int(prefilled[s])
+                    t0 = time.perf_counter() if tr else 0.0
                     with prefill_timer.step(images=n):
                         tok, _ = eng.prefill(
                             prompt[base:base + n], slot=s,
                             request_id=r.id, base=base,
+                        )
+                    if tr:
+                        tr.complete("prefill_chunk", t0,
+                                    time.perf_counter(),
+                                    req=int(r.id), slot=s, base=base, n=n)
+                    if reg is not None:
+                        reg.counter("serve_prefill_tokens_total").inc(n)
+                        # The SAME bracket value the StepTimer recorded,
+                        # so the two latency surfaces cannot disagree.
+                        reg.histogram("serve_prefill_seconds").observe(
+                            prefill_timer._times[-1]
                         )
                     prefilled[s] += n
                     lengths[s] = prefilled[s]  # see admission comment
@@ -385,27 +464,54 @@ class Scheduler:
                         budget -= n
                     if base + n == p:  # prompt complete: first token
                         if eng.prefix is not None and store_after[s]:
-                            eng.prefix_store(prompt, s)
+                            stored = eng.prefix_store(prompt, s)
+                            if tr and stored:
+                                tr.event("prefix_store", req=int(r.id),
+                                         slot=s, rows=p)
                         active[s] = True
                         lengths[s] = p
                         last_tokens[s] = tok
                         req_ids[s] = r.id
                         generated[s] = [tok]
-                        ttfts.append(
-                            time.perf_counter() - eligible_wall[r.id]
-                        )
+                        t_first = time.perf_counter()
+                        ttfts.append(t_first - eligible_wall[r.id])
+                        if tr:
+                            # Same `t_first` as the TTFT sample above —
+                            # derive_request_slo recovers it exactly.
+                            tr.event("first_token", t=t_first,
+                                     req=int(r.id), slot=s, step=step)
+                        if reg is not None:
+                            reg.histogram("serve_ttft_seconds").observe(
+                                ttfts[-1]
+                            )
                         if finished(s, tok):
                             finish(s)
                         break
             if active.any():
-                with decode_timer.step(images=int(active.sum())):
+                n_active = int(active.sum())
+                t0 = time.perf_counter() if tr else 0.0
+                with decode_timer.step(images=n_active):
                     nxt, _ = eng.decode(last_tokens, lengths, req_ids, active)
                 now = time.perf_counter()
-                if last_decode_done is not None:
+                chained = last_decode_done is not None
+                if chained:
                     # The gap since the previous decode completion —
                     # prefill work interleaved between ticks included.
                     itls.append(now - last_decode_done)
                 last_decode_done = now
+                if tr:
+                    # End timestamp == the ITL clock's `now`; `chained`
+                    # records whether the gap-to-previous counted, so
+                    # derive_request_slo replays the ITL stream exactly.
+                    tr.complete("decode_tick", t0, now, step=step,
+                                n_active=n_active, chained=chained)
+                if reg is not None:
+                    reg.counter("serve_decode_tokens_total").inc(n_active)
+                    reg.histogram("serve_decode_step_seconds").observe(
+                        decode_timer._times[-1]
+                    )
+                    if chained:
+                        reg.histogram("serve_itl_seconds").observe(itls[-1])
                 for s in range(S):
                     if not active[s]:
                         continue
@@ -419,6 +525,28 @@ class Scheduler:
                 # No decoder advanced this tick: the next decode's gap
                 # is idle/prefill lead-in, not an inter-token stall.
                 last_decode_done = None
+            if reg is not None:
+                # Per-tick utilization gauges (sampled, last-write-wins
+                # in the registry; history lands in the JSONL snapshots).
+                depth = 0
+                for q in pending:  # (arrival, id)-sorted: early break
+                    if q.arrival > step:
+                        break
+                    depth += 1
+                reg.gauge("serve_queue_depth").set(depth)
+                reg.gauge("serve_active_slots").set(int(active.sum()))
+                reg.gauge("serve_occupied_slots").set(
+                    sum(o is not None for o in occupant)
+                )
+                if eng.prefix is not None:
+                    reg.gauge("serve_prefix_pool_entries").set(
+                        len(eng.prefix)
+                    )
+                if self.metrics_writer is not None:
+                    # Rate-limited internally (interval_s): the per-tick
+                    # gauge HISTORY lands in the JSONL as a time series,
+                    # not just the final tick's values.
+                    self.metrics_writer.maybe_flush()
             step += 1
             if all(o is None for o in occupant) and pending:
                 # Idle gap before the next arrival: every intervening
@@ -428,6 +556,10 @@ class Scheduler:
                 step = max(step, pending[0].arrival)
 
         latency = decode_timer.stats()
+        if reg is not None:
+            reg.counter("serve_prefix_lookups_total").inc(lookups)
+            reg.counter("serve_prefix_hits_total").inc(hits)
+            reg.counter("serve_prefill_tokens_saved_total").inc(saved)
         stats = ServeStats(
             prefill_tokens=prefill_timer.total_images,
             prefill_s=prefill_timer.total_s,
@@ -443,3 +575,35 @@ class Scheduler:
             prefill_tokens_saved=saved,
         )
         return done, stats
+
+
+def derive_request_slo(records) -> tuple[StepStats, StepStats]:
+    """``(ttft, itl)`` ``StepStats`` derived PURELY from a run's tracer
+    records (``Tracer.records`` or a read-back JSONL file).
+
+    Works because the scheduler stamps the lifecycle events with the
+    SAME ``perf_counter`` values its own SLO math uses: TTFT is
+    ``first_token.t - eligible.t`` per request, ITL the gap between
+    consecutive ``decode_tick`` end timestamps whose later tick is
+    ``chained`` (an idle/prefill-lead-in tick breaks the chain exactly
+    as the live computation's reset does). The result is EXACTLY equal
+    — same floats, not approximately — to ``ServeStats.ttft``/``.itl``
+    of the run that produced the records (pinned at tp=1 and tp=2 in
+    tests/test_obs.py), which is what makes the trace a sufficient
+    record of a run's SLO story."""
+    eligible: dict[int, float] = {}
+    ttfts: list[float] = []
+    itls: list[float] = []
+    prev: float | None = None
+    for rec in records:
+        name = rec.get("name")
+        attrs = rec.get("attrs", {})
+        if name == "eligible":
+            eligible.setdefault(attrs["req"], rec["t"])
+        elif name == "first_token":
+            ttfts.append(rec["t"] - eligible[attrs["req"]])
+        elif name == "decode_tick":
+            if attrs.get("chained") and prev is not None:
+                itls.append(rec["t"] - prev)
+            prev = rec["t"]
+    return StepStats.from_times(ttfts), StepStats.from_times(itls)

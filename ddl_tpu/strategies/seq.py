@@ -73,6 +73,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..data.lm import LMDataset
 from ..models import transformer
+from ..obs import health as hlt
+from ..obs.trace import NULL_TRACER
 from ..models.transformer import LMSpec
 from ..ops import adam_init, adam_update
 from ..ops.optimizers import AdamState
@@ -425,7 +427,7 @@ class _FlatPlan:
 
 
 def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
-                     platform: str | None = None):
+                     platform: str | None = None, health: bool = False):
     """One ZeRO-1 train step inside ``shard_map`` (``check_vma=False``,
     like the CNN sharded path): grads here are LOCAL — each shard
     differentiates its own scored-token sum over the GLOBAL denominator
@@ -453,7 +455,16 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
         )
         p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
         full = lax.all_gather(p_new, AXES, tiled=True)[: plan.total]
-        return plan.unflatten(full), opt, loss
+        new_tree = plan.unflatten(full)
+        if not health:
+            return new_tree, opt, loss
+        # Grad stats from the flat chunks (disjoint over dp x sp — one
+        # psum is the global answer); param/update norms from the full
+        # trees both sides of the update, which zero1 keeps replicated.
+        sq, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+        h = {"grad_norm": jnp.sqrt(sq), "nonfinite_grads": nf,
+             **hlt.norm_signals(params, new_tree, None)}
+        return new_tree, opt, loss, {k: h[k] for k in hlt.health_keys(params)}
 
     return step
 
@@ -533,7 +544,7 @@ class _HybridPlan:
 
 
 def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
-                        platform: str | None = None):
+                        platform: str | None = None, health: bool = False):
     """One hybrid zero1 x tensor_parallel train step inside ``shard_map``
     (``check_vma=False``). Local grads come out of ``_local_loss_fn``
     dp/sp-partial and tp-complete (the f/g pair); then each subtree gets
@@ -588,7 +599,29 @@ def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
         )
         opt = HybridAdam(step=flat.step, m_flat=flat.m, v_flat=flat.v,
                          m_tp=tp_state.m, v_tp=tp_state.v)
-        return hplan.merge(rep_new, tp_new), opt, loss
+        new_tree = hplan.merge(rep_new, tp_new)
+        if not health:
+            return new_tree, opt, loss
+        # Replicated subtree: flat-chunk stats over (dp, sp). tp leaves:
+        # g_tp is already (dp, sp)-complete per shard, so their squared
+        # sums / non-finite counts reduce over tp only. Param/update
+        # norms take the trainer's spec tree, which names exactly that
+        # tp sharding.
+        sq, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+        tp_sq = sum(
+            (jnp.sum(jnp.square(g.astype(jnp.float32))) for g in g_tp),
+            jnp.float32(0.0),
+        )
+        tp_nf = sum(
+            (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)))
+             .astype(jnp.int32) for g in g_tp),
+            jnp.int32(0),
+        )
+        sq = sq + lax.psum(tp_sq, TP_AXIS)
+        nf = nf + lax.psum(tp_nf, TP_AXIS)
+        h = {"grad_norm": jnp.sqrt(sq), "nonfinite_grads": nf,
+             **hlt.norm_signals(params, new_tree, _param_specs(config))}
+        return new_tree, opt, loss, {k: h[k] for k in hlt.health_keys(params)}
 
     return step
 
@@ -619,14 +652,21 @@ def _local_loss_fn(config: SeqConfig, attn, tokens, targets, weights):
     return local_loss
 
 
-def _step_body(config: SeqConfig, platform: str | None = None):
+def _step_body(config: SeqConfig, platform: str | None = None,
+               health: bool = False):
     """One train step, already inside ``shard_map`` (``check_vma=False``):
     local grads (see ``_local_loss_fn``), ONE explicit ``psum`` over the
     (dp, sp) axes — full gradients for replicated leaves, per-shard-full
     gradients for tp-sharded leaves (their dp/sp partials are
     tp-shard-local already) — then the TF1-Adam update on state that
     mirrors the param placement. The pattern is pinned against the
-    single-device oracle by tests/test_lm.py."""
+    single-device oracle by tests/test_lm.py.
+
+    ``health=True`` appends the in-graph health dict (``obs.health``,
+    computed on the FULLY-REDUCED grads — tp-sharded leaves' squared
+    sums psum over tp per the param specs) as a fourth output; the flag
+    is a Python-level branch, so ``health=False`` compiles the exact
+    pre-observability program."""
     attn = _attn_for(config, platform)
 
     def step(params, opt_state, tokens, targets, weights):
@@ -634,10 +674,15 @@ def _step_body(config: SeqConfig, platform: str | None = None):
         l_local, grads = jax.value_and_grad(local_loss)(params)
         loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
         grads = jax.tree.map(lambda g: lax.psum(g, AXES), grads)
-        params, opt_state = adam_update(
+        new_params, new_opt = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
-        return params, opt_state, loss
+        if not health:
+            return new_params, new_opt, loss
+        h = hlt.health_signals(
+            grads, params, new_params, _param_specs(config)
+        )
+        return new_params, new_opt, loss, h
 
     return step
 
@@ -829,11 +874,21 @@ class SeqTrainer:
         inflate dp-fold so accuracies stay exact)."""
         return P(*([None] * (ndim - 1) + [SP_AXIS]))
 
-    def _span_fn(self, k: int):
+    def span_program(self, k: int, health: bool = False):
         """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
         ``k`` consecutive batches as ONE device-resident program
-        (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``)."""
+        (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``).
+        Public: benchmarks time exactly this object (lm_bench/scaling —
+        the product path by construction).
+
+        ``health=True`` appends a dict of ``[k]``-stacked in-graph
+        health signals (``obs.health``) as a fourth output — computed
+        per step inside the scan, fetched by the caller in ONE batched
+        device->host transfer, so the hot path never gains a per-step
+        sync. ``health=False`` builds the exact pre-observability
+        program."""
         seq = P(DP_AXIS, SP_AXIS)  # train batch [B, T]: B over dp, T over sp
+        hspec = hlt.health_out_specs(self._host_like) if health else None
         # EVERY step body runs check_vma=False (local-grads mode): each
         # body computes unreduced dp/sp gradients and applies its own
         # explicit reduction (psum / psum_scatter); a replication checker
@@ -847,7 +902,7 @@ class SeqTrainer:
             from ..pipeline.trainer import pipeline_shard_step
 
             shard_step = pipeline_shard_step(
-                self.config, self.mesh, self._platform
+                self.config, self.mesh, self._platform, health=health
             )
         elif self._hplan is not None:
             opt_spec = HybridAdam(
@@ -857,40 +912,50 @@ class SeqTrainer:
             )
             shard_step = jax.shard_map(
                 _zero1_tp_step_body(self.config, self._hplan,
-                                    self._platform),
+                                    self._platform, health=health),
                 mesh=self.mesh,
                 in_specs=(self._pspecs, opt_spec, seq, seq, seq),
-                out_specs=(self._pspecs, opt_spec, P()),
+                out_specs=(self._pspecs, opt_spec, P())
+                + ((hspec,) if health else ()),
                 check_vma=False,
             )
         elif self.config.zero1:
             opt_spec = ShardedAdam(step=P(), m=P(AXES), v=P(AXES))
             shard_step = jax.shard_map(
-                _zero1_step_body(self.config, self._plan, self._platform),
+                _zero1_step_body(self.config, self._plan, self._platform,
+                                 health=health),
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, seq, seq, seq),
-                out_specs=(P(), opt_spec, P()),
+                out_specs=(P(), opt_spec, P())
+                + ((hspec,) if health else ()),
                 check_vma=False,
             )
         else:
             shard_step = jax.shard_map(
-                _step_body(self.config, self._platform),
+                _step_body(self.config, self._platform, health=health),
                 mesh=self.mesh,
                 in_specs=(self._pspecs, self._opt_specs, seq, seq, seq),
-                out_specs=(self._pspecs, self._opt_specs, P()),
+                out_specs=(self._pspecs, self._opt_specs, P())
+                + ((hspec,) if health else ()),
                 check_vma=False,
             )
 
         def run(params, opt_state, xs, ys, ws, first):
             def body(carry, i):
                 p, o = carry
+                if health:
+                    p, o, l, h = shard_step(p, o, xs[i], ys[i], ws[i])
+                    return (p, o), (l, h)
                 p, o, l = shard_step(p, o, xs[i], ys[i], ws[i])
                 return (p, o), l
 
-            (params, opt_state), losses = steps_scan(
+            (params, opt_state), out = steps_scan(
                 body, (params, opt_state), first + jnp.arange(k), k
             )
-            return params, opt_state, losses[-1]
+            if health:
+                losses, healths = out
+                return params, opt_state, losses[-1], healths
+            return params, opt_state, out[-1]
 
         # Donate params + optimizer state (halved peak HBM, like every
         # other trainer's step); donation_for gates off the multi-device
@@ -935,7 +1000,11 @@ class SeqTrainer:
         loss mask follows its tokens."""
         return arr if self._perm is None else arr[:, self._perm]
 
-    def _stage(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
+    def stage_batches(self, arr: np.ndarray, batches: int, bs: int) -> jax.Array:
+        """Stage ``batches`` x ``bs`` rows of ``arr`` onto the mesh as
+        the span programs' ``[nb, B, T]`` input placement. Public: the
+        benchmarks stage through this so they feed ``span_program``
+        exactly what the trainer does."""
         shaped = self._permuted(arr[: batches * bs]).reshape(
             batches, bs, arr.shape[1]
         )
@@ -1109,6 +1178,10 @@ class SeqTrainer:
         profile_dir: str | None = None,
         should_stop=None,
         dispatch_timeout: float = 0.0,
+        metrics=None,
+        metrics_interval: int = 10,
+        metrics_writer=None,
+        tracer=None,
     ) -> LMResult:
         """Same persistence/observability contract as every other trainer:
         atomic rolling checkpoint at epoch ends (plus every
@@ -1116,17 +1189,29 @@ class SeqTrainer:
         ``resume_plan``, graceful preemption through ``check_preempt``,
         ``dispatch_timeout`` accelerator-death watchdog, ``jax.profiler``
         trace under ``profile_dir``. The LM step has no RNG (no dropout),
-        so a resumed run is bit-identical to an uninterrupted one."""
+        so a resumed run is bit-identical to an uninterrupted one.
+
+        Telemetry (ISSUE 5): ``metrics`` is an ``obs.MetricRegistry``
+        — when given, the span programs compute in-graph health signals
+        (``obs.health``) and the trainer fetches them BATCHED on spans
+        crossing ``metrics_interval`` global steps (never per step —
+        the hot path gains no sync; with ``metrics=None`` the compiled
+        programs are byte-identical to the pre-observability ones).
+        ``metrics_writer`` (an ``obs.MetricsWriter``) is flushed on its
+        own interval from the span loop. ``tracer`` (``obs.Tracer``)
+        wraps every span dispatch and eval in host wall-clock spans."""
         cfg = self.config
+        if tracer is None:
+            tracer = NULL_TRACER
         ds = self.dataset
         bs = cfg.batch_size
         # batch_size vs num_train is validated in __init__ (every config
         # pre-flight lives there, so the CLI's ValueError guard can wrap
         # construction only — round-4 advisor).
         batch_num = ds.num_train // bs
-        xs = self._stage(ds.tokens, batch_num, bs)
-        ys = self._stage(ds.targets, batch_num, bs)
-        ws = self._stage(ds.weights, batch_num, bs)
+        xs = self.stage_batches(ds.tokens, batch_num, bs)
+        ys = self.stage_batches(ds.targets, batch_num, bs)
+        ws = self.stage_batches(ds.weights, batch_num, bs)
         put_test = lambda a: multihost.put(
             self.mesh, self._seq_spec(2), self._permuted(a)
         )
@@ -1161,9 +1246,10 @@ class SeqTrainer:
         resume_epoch, resume_spans = resume_plan(
             start_step, batch_num, cfg.eval_every, spans
         )
+        health_on = metrics is not None
         t0 = time.perf_counter()
         fns = {
-            k: self._span_fn(k)
+            k: self.span_program(k, health=health_on)
             .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
             .compile()
             for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}
@@ -1189,22 +1275,60 @@ class SeqTrainer:
                     if gstep < start_step:
                         continue  # already done by the resumed run
                     span_idx += 1
-                    with timer.step(images=k * tokens_per_batch):
-                        params, opt_state, l = fns[k](
+                    with timer.step(images=k * tokens_per_batch), \
+                            tracer.span("train/span", gstep=gstep, k=k):
+                        out = fns[k](
                             params, opt_state, xs, ys, ws, jnp.int32(first)
                         )
+                        if health_on:
+                            params, opt_state, l, hstack = out
+                        else:
+                            params, opt_state, l = out
                         # barrier: host fetch of the span loss (the whole
                         # span chain executes to produce it)
                         loss = guarded(
                             lambda: float(l), dispatch_timeout,
                             f"span dispatch at global batch {gstep}",
                         )
-                    if eval_after:
-                        accuracy = guarded(
-                            lambda: float(ev(params, xte, yte, wte)),
-                            dispatch_timeout,
-                            f"eval after batch {first + k - 1}",
+                    if metrics is not None:
+                        span_s = timer._times[-1]  # the bracket just closed
+                        metrics.gauge("train_loss").set(loss)
+                        metrics.gauge("train_step").set(gstep + k)
+                        metrics.histogram(
+                            "train_span_seconds",
+                            "wall seconds per dispatched span program",
+                        ).observe(span_s)
+                        metrics.gauge("train_tokens_per_sec").set(
+                            k * tokens_per_batch / span_s if span_s else 0.0
                         )
+                        # The divergence tripwire reads EVERY span (a
+                        # [k] int32 fetch riding the loss barrier — the
+                        # span already executed, this adds no sync); the
+                        # full norm dict is fetched batched only on
+                        # spans crossing the metrics interval
+                        # (save_crossed reused as the crossing
+                        # predicate).
+                        hlt.record_nonfinite(
+                            metrics,
+                            jax.device_get(hstack["nonfinite_grads"]),
+                        )
+                        if save_crossed(gstep, k, metrics_interval,
+                                        first + k == batch_num):
+                            hlt.record_health(
+                                metrics, jax.device_get(hstack),
+                                include_nonfinite=False,
+                            )
+                        if metrics_writer is not None:
+                            metrics_writer.maybe_flush()
+                    if eval_after:
+                        with tracer.span("train/eval", gstep=gstep + k):
+                            accuracy = guarded(
+                                lambda: float(ev(params, xte, yte, wte)),
+                                dispatch_timeout,
+                                f"eval after batch {first + k - 1}",
+                            )
+                        if metrics is not None:
+                            metrics.gauge("train_eval_accuracy").set(accuracy)
                         history.append((epoch, first + k - 1, accuracy))
                         log(
                             f"epoch {epoch} batch {first + k - 1} "
@@ -1247,7 +1371,7 @@ class SeqTrainer:
         stats = timer.stats()
         log(
             f"final test_accuracy {accuracy:.4f} loss {loss:.4f} "
-            f"({stats.images_per_sec:.0f} tokens/s)"
+            f"({stats.tokens_per_sec:.0f} tokens/s)"
         )
         return LMResult(
             params=self._result_params(params),
@@ -1256,7 +1380,7 @@ class SeqTrainer:
             wall_time_s=wall,
             train_time_s=stats.total_s,
             history=history,
-            tokens_per_sec=stats.images_per_sec,
+            tokens_per_sec=stats.tokens_per_sec,
             compile_time_s=compile_time,
             step_stats=stats,
             resumed_from_step=start_step,

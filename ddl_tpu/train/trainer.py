@@ -53,9 +53,14 @@ class TrainResult:
 
 def make_train_step(
     config: TrainConfig,
+    health: bool = False,
 ) -> Callable[[dict, AdamState, jax.Array, jax.Array, jax.Array], tuple[dict, AdamState, jax.Array]]:
     """Build the jittable single-chip train step:
-    ``(params, opt_state, x, y_onehot, rng) -> (params', opt_state', loss)``."""
+    ``(params, opt_state, x, y_onehot, rng) -> (params', opt_state', loss)``.
+    ``health=True`` appends the in-graph health dict (``obs.health`` —
+    grad norm, per-variable param/update norms, non-finite count) as a
+    fourth output; the flag is a Python-level branch, so the default
+    program is byte-identical to the pre-observability one."""
     compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
 
     def step(params, opt_state, x, y, rng):
@@ -68,10 +73,15 @@ def make_train_step(
             compute_dtype=compute_dtype,
             conv_matmul=config.conv_matmul_mode(),
         )
-        params, opt_state = adam_update(
+        new_params, new_opt = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
-        return params, opt_state, loss
+        if not health:
+            return new_params, new_opt, loss
+        from ..obs import health as hlt
+
+        h = hlt.health_signals(grads, params, new_params, None)
+        return new_params, new_opt, loss, h
 
     return step
 
@@ -229,7 +239,9 @@ def resume_plan(
     return resume_epoch, resume_spans
 
 
-def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
+def make_epoch_chunk(
+    config: TrainConfig, k: int, health: bool = False
+) -> Callable:
     """The single-chip device-resident multi-step program, shared by
     ``SingleChipTrainer`` and ``bench.py`` (so the benchmark measures the
     product path by construction).
@@ -240,8 +252,11 @@ def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
     first batch index (traced — one compilation per distinct ``k``) and
     ``goff`` the global step offset feeding the dropout stream (identical
     stream to a per-step loop, so span chunking never changes numerics).
+
+    ``health=True`` appends the ``[k]``-stacked in-graph health dict as
+    a fourth output (fetched batched by the trainer — obs.health).
     """
-    step = make_train_step(config)
+    step = make_train_step(config, health=health)
 
     def chunk(params, opt_state, xs, ys, first, goff, rng_base):
         def body(carry, i):
@@ -249,13 +264,19 @@ def make_epoch_chunk(config: TrainConfig, k: int) -> Callable:
             x = jax.lax.dynamic_index_in_dim(xs, first + i, 0, keepdims=False)
             y = jax.lax.dynamic_index_in_dim(ys, first + i, 0, keepdims=False)
             rng = jax.random.fold_in(rng_base, goff + i)
+            if health:
+                params, opt_state, loss, h = step(params, opt_state, x, y, rng)
+                return (params, opt_state), (loss, h)
             params, opt_state, loss = step(params, opt_state, x, y, rng)
             return (params, opt_state), loss
 
-        (params, opt_state), losses = steps_scan(
+        (params, opt_state), out = steps_scan(
             body, (params, opt_state), jnp.arange(k), k
         )
-        return params, opt_state, losses.mean()
+        if health:
+            losses, healths = out
+            return params, opt_state, losses.mean(), healths
+        return params, opt_state, out.mean()
 
     return jax.jit(chunk, donate_argnums=(0, 1))
 
@@ -455,13 +476,17 @@ class SingleChipTrainer:
             else cnn.init_params(self.init_key, specs=config.model_specs())
         )
         self.opt_state = adam_init(self.params)
-        self._chunks: dict[int, Callable] = {}
+        self._chunks: dict[tuple[int, bool], Callable] = {}
 
-    def _chunk_fn(self, k: int) -> Callable:
-        """Cached :func:`make_epoch_chunk` program for span length ``k``."""
-        if k not in self._chunks:
-            self._chunks[k] = make_epoch_chunk(self.config, k)
-        return self._chunks[k]
+    def _chunk_fn(self, k: int, health: bool = False) -> Callable:
+        """Cached :func:`make_epoch_chunk` program for span length ``k``
+        (one cache entry per (k, health) — the health variant is a
+        different program)."""
+        if (k, health) not in self._chunks:
+            self._chunks[(k, health)] = make_epoch_chunk(
+                self.config, k, health=health
+            )
+        return self._chunks[(k, health)]
 
     def train(
         self,
@@ -473,8 +498,23 @@ class SingleChipTrainer:
         profile_dir: str | None = None,
         should_stop: Callable[[], bool] | None = None,
         dispatch_timeout: float = 0.0,
+        metrics=None,
+        metrics_interval: int = 10,
+        metrics_writer=None,
+        tracer=None,
     ) -> TrainResult:
+        """``metrics``/``metrics_interval``/``metrics_writer``/``tracer``
+        are the ISSUE-5 telemetry hooks (``obs``): with a registry the
+        span programs compute in-graph health and the trainer fetches it
+        batched on spans crossing ``metrics_interval`` steps; with
+        ``metrics=None`` the compiled programs are byte-identical to the
+        pre-observability ones (no added sync — the acceptance bar)."""
         cfg = self.config
+        if tracer is None:
+            from ..obs.trace import NULL_TRACER
+
+            tracer = NULL_TRACER
+        health_on = metrics is not None
         batch_num = self.dataset.num_train // cfg.batch_size
         n = batch_num * cfg.batch_size
         # Sequential batching, no shuffle — reference semantics
@@ -522,7 +562,8 @@ class SingleChipTrainer:
         t0 = time.perf_counter()
         args0 = (jnp.int32(0), jnp.int32(0), self.dropout_key)
         fns = {
-            k: self._chunk_fn(k).lower(params, opt_state, xs, ys, *args0).compile()
+            k: self._chunk_fn(k, health=health_on)
+            .lower(params, opt_state, xs, ys, *args0).compile()
             for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}
         }
         # Warm the eval program too: its first call otherwise compiles
@@ -544,23 +585,57 @@ class SingleChipTrainer:
                     if gstep < start_step:
                         continue  # already done by the resumed run
                     span_idx += 1
-                    with timer.step(images=k * cfg.batch_size):
-                        params, opt_state, _ = fns[k](
+                    with timer.step(images=k * cfg.batch_size), \
+                            tracer.span("train/span", gstep=gstep, k=k):
+                        out = fns[k](
                             params, opt_state, xs, ys,
                             jnp.int32(first), jnp.int32(gstep),
                             self.dropout_key,
                         )
+                        if health_on:
+                            params, opt_state, _, hstack = out
+                        else:
+                            params, opt_state, _ = out
                         # barrier: the fns[k] span dispatch
                         force_within(
                             params, dispatch_timeout,
                             f"span dispatch at global step {gstep}",
                         )
+                    if metrics is not None:
+                        from ..obs import health as hlt
+
+                        span_s = timer._times[-1]  # the bracket just closed
+                        metrics.gauge("train_step").set(gstep + k)
+                        metrics.histogram(
+                            "train_span_seconds",
+                            "wall seconds per dispatched span program",
+                        ).observe(span_s)
+                        metrics.gauge("train_images_per_sec").set(
+                            k * cfg.batch_size / span_s if span_s else 0.0
+                        )
+                        # Tripwire from EVERY span (tiny [k] int32 fetch
+                        # after the span barrier); full norm dict only on
+                        # interval-crossing spans (batched fetch).
+                        hlt.record_nonfinite(
+                            metrics,
+                            jax.device_get(hstack["nonfinite_grads"]),
+                        )
+                        if save_crossed(gstep, k, metrics_interval,
+                                        first + k == batch_num):
+                            hlt.record_health(metrics,
+                                              jax.device_get(hstack),
+                                              include_nonfinite=False)
+                        if metrics_writer is not None:
+                            metrics_writer.maybe_flush()
                     if eval_after:
                         cnt = first + k - 1
-                        acc = guarded(
-                            lambda: evaluate(params, x_test, y_test),
-                            dispatch_timeout, f"eval after batch {cnt}",
-                        )
+                        with tracer.span("train/eval", gstep=gstep + k):
+                            acc = guarded(
+                                lambda: evaluate(params, x_test, y_test),
+                                dispatch_timeout, f"eval after batch {cnt}",
+                            )
+                        if metrics is not None:
+                            metrics.gauge("train_eval_accuracy").set(acc)
                         history.append((epoch, cnt, acc))
                         log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
                         stopped = hit_target(cfg, acc)

@@ -24,18 +24,31 @@ class StepStats:
     p50_ms: float
     p95_ms: float
     total_s: float
+    # Items per second. "Items" are whatever the caller counted — MNIST
+    # images for the CNN trainers, TOKENS for the LM/serving paths; the
+    # ``tokens_per_sec`` property is the honestly-named read for the
+    # latter (the field name predates the LM vertical and is pinned by
+    # existing JSON artifacts/tests, so it stays the storage name).
     images_per_sec: float
     # Tail latency: the serving SLO percentile (one decode step = one
     # token per slot, serve/scheduler.py). Defaulted so older pickled/
     # JSON artifacts missing the field still construct.
     p99_ms: float = 0.0
 
-    def line(self) -> str:
+    @property
+    def tokens_per_sec(self) -> float:
+        """Alias of ``images_per_sec`` for the token-counting paths
+        (LM training, serving) — same number, honest name."""
+        return self.images_per_sec
+
+    def line(self, unit: str = "img/s") -> str:
+        """One-line summary; ``unit`` labels the throughput column
+        (``"tok/s"`` for the LM/serving paths)."""
         return (
             f"steps={self.steps} mean={self.mean_ms:.2f}ms "
             f"p50={self.p50_ms:.2f}ms p95={self.p95_ms:.2f}ms "
             f"p99={self.p99_ms:.2f}ms "
-            f"throughput={self.images_per_sec:.0f} img/s"
+            f"throughput={self.images_per_sec:.0f} {unit}"
         )
 
     @classmethod
@@ -48,7 +61,11 @@ class StepStats:
         reads 0 (a latency-only distribution)."""
         times = np.asarray(list(times_s), np.float64)
         if times.size == 0:
-            return cls(0, 0.0, 0.0, 0.0, 0.0, 0.0)
+            # Every field explicit: the old positional 6-tuple silently
+            # leaned on the p99_ms default — one field reorder away from
+            # assigning a percentile into total_s (pinned in test_utils).
+            return cls(steps=0, mean_ms=0.0, p50_ms=0.0, p95_ms=0.0,
+                       p99_ms=0.0, total_s=0.0, images_per_sec=0.0)
         total = float(times.sum())
         n_images = float(np.sum(images)) if images is not None else 0.0
         return cls(

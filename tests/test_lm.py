@@ -761,10 +761,10 @@ def test_seq_trainer_activation_memory_scales_with_shard():
             SeqConfig(num_workers=W, scheme="ring", batch_size=4, spec=SPEC),
             ds,
         )
-        xs = tr._stage(ds.tokens, 1, 4)
-        ys = tr._stage(ds.targets, 1, 4)
-        ws = tr._stage(ds.weights, 1, 4)
-        c = tr._span_fn(1).lower(
+        xs = tr.stage_batches(ds.tokens, 1, 4)
+        ys = tr.stage_batches(ds.targets, 1, 4)
+        ws = tr.stage_batches(ds.weights, 1, 4)
+        c = tr.span_program(1).lower(
             tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0)
         ).compile()
         return c.memory_analysis().temp_size_in_bytes

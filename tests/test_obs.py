@@ -1,0 +1,400 @@
+"""Unified telemetry tests (ISSUE 5): registry label-set semantics,
+histogram/StepStats percentile parity, span nesting + JSONL round-trip,
+the derived-TTFT/ITL ≡ ServeStats pin at tp=1 AND tp=2, and the
+in-graph health signals against a single-device ``jax.grad`` oracle on
+the dp2 x tp2 (and zero1 / hybrid / pipeline) meshes."""
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.data.lm import synthesize_copy, synthesize_prompts
+from ddl_tpu.models import transformer
+from ddl_tpu.models.transformer import TINY_SPEC
+from ddl_tpu.obs import MetricRegistry, MetricsWriter, Tracer, run_manifest
+from ddl_tpu.obs import health as hlt
+from ddl_tpu.obs.trace import NULL_TRACER, chrome_trace_events, read_jsonl
+from ddl_tpu.parallel import ring
+from ddl_tpu.utils.metrics import StepStats
+
+SPEC = TINY_SPEC
+T = 32
+B = 4
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_label_set_semantics():
+    """Each distinct label set is an independent series; the same set
+    (any key order) accumulates; kind conflicts and counter decreases
+    are errors."""
+    reg = MetricRegistry()
+    c = reg.counter("req_total")
+    c.inc(2, tp=1, slots=4)
+    c.inc(3, slots=4, tp=1)  # same set, different order
+    c.inc(1, tp=2, slots=4)
+    c.inc()  # the unlabelled series is its own series
+    assert c.value(tp=1, slots=4) == 5
+    assert c.value(tp=2, slots=4) == 1
+    assert c.value() == 1
+    assert c.value(tp=3, slots=4) == 0  # untouched series reads 0
+    assert len(c.label_sets()) == 3
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(7, queue="a")
+    g.set(9, queue="a")  # last write wins
+    assert g.value(queue="a") == 9
+    assert g.value(queue="b") is None
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("req_total")
+    # Same name re-requested with the same kind returns the instance.
+    assert reg.counter("req_total") is c
+
+
+def test_histogram_percentiles_match_stepstats_from_times():
+    """The registry histogram and ``StepStats.from_times`` are ONE
+    percentile definition: stats() is field-for-field equal, and
+    ``percentile`` matches np.percentile's linear interpolation on the
+    raw samples (including the n=1/n=2 edges test_utils pins for
+    StepStats)."""
+    for samples in ([0.010, 0.020, 0.030, 0.040], [0.012], [0.010, 0.030]):
+        reg = MetricRegistry()
+        h = reg.histogram("lat")
+        h.observe_many(samples)
+        assert h.stats() == StepStats.from_times(samples)
+        assert h.percentile(95) == pytest.approx(
+            float(np.percentile(samples, 95))
+        )
+    assert MetricRegistry().histogram("empty").stats() == \
+        StepStats.from_times([])
+
+
+def test_prometheus_text_and_snapshot():
+    reg = MetricRegistry()
+    reg.counter("c", "help line").inc(5, tp=1)
+    reg.gauge("g").set(2.5)
+    reg.histogram("h").observe_many([0.010, 0.020, 0.030, 0.040])
+    text = reg.prometheus_text()
+    assert "# HELP c help line" in text
+    assert '# TYPE c counter' in text and 'c{tp="1"} 5' in text
+    assert "g 2.5" in text
+    assert 'h{quantile="0.95"} 0.0385' in text
+    assert "h_count 4" in text
+    snap = reg.snapshot()
+    by = {(r["name"], tuple(sorted(r["labels"].items()))): r for r in snap}
+    assert by[("c", (("tp", "1"),))]["value"] == 5
+    h = by[("h", ())]
+    assert h["count"] == 4 and h["p50"] == pytest.approx(0.025)
+
+
+# -- tracer -----------------------------------------------------------------
+
+
+def test_tracer_span_nesting_and_ordering():
+    tr = Tracer()  # in-memory
+    with tr.span("outer", a=1):
+        tr.event("mid")
+        with tr.span("inner"):
+            pass
+    # Spans emit at END: child before parent; depth is the span's own
+    # nesting level; t0/t1 of the child nest inside the parent's.
+    assert [r["name"] for r in tr.records] == ["mid", "inner", "outer"]
+    mid, inner, outer = tr.records
+    assert outer["depth"] == 0 and inner["depth"] == 1 and mid["depth"] == 1
+    assert outer["t0"] <= inner["t0"] <= inner["t"] <= outer["t"]
+    assert outer["attrs"] == {"a": 1}
+    assert [r["seq"] for r in tr.records] == [0, 1, 2]
+    # The null tracer is falsy (call sites gate clock reads on it) and
+    # records nothing; a real tracer is truthy.
+    assert not NULL_TRACER and tr
+    with NULL_TRACER.span("x"):
+        NULL_TRACER.event("y")
+    assert NULL_TRACER.records == ()
+
+
+def test_trace_jsonl_roundtrip_and_chrome_conversion(tmp_path):
+    path = tmp_path / "host_trace_p0.jsonl"
+    tr = Tracer(path)
+    with tr.span("outer"):
+        tr.event("tick", t=1.5, req=3)
+    tr.close()
+    recs = read_jsonl(path)
+    assert [r["name"] for r in recs] == ["tick", "outer"]
+    assert recs[0]["attrs"] == {"req": 3} and recs[0]["t"] == 1.5
+    assert all("pid" in r and "process_index" in r and "t_wall" in r
+               for r in recs)
+    evs = chrome_trace_events(recs)
+    # Sorted by timestamp: the instant at t=1.5 precedes nothing that
+    # started earlier; span -> "X" with µs ts/dur, event -> "i".
+    kinds = {e["name"]: e["ph"] for e in evs}
+    assert kinds == {"tick": "i", "outer": "X"}
+    span = next(e for e in evs if e["ph"] == "X")
+    assert span["dur"] >= 0 and span["ts"] > 0
+
+
+def test_metrics_writer_manifest_first_and_snapshot_roundtrip(tmp_path):
+    from ddl_tpu.strategies.seq import SeqConfig
+
+    reg = MetricRegistry()
+    reg.counter("c").inc(4)
+    path = tmp_path / "metrics.jsonl"
+    man = run_manifest(config=SeqConfig(spec=SPEC), extra={"variant": "lm"})
+    with MetricsWriter(path, reg, man, interval_s=0.0) as w:
+        w.maybe_flush()
+        reg.counter("c").inc(1)
+    lines = [json.loads(line) for line in open(path)]
+    # Manifest FIRST (ISSUE 5 satellite): versions + config dump present.
+    assert lines[0]["record"] == "manifest"
+    assert lines[0]["jax_version"] == jax.__version__
+    assert lines[0]["config"]["spec"]["d_model"] == SPEC.d_model
+    assert lines[0]["variant"] == "lm"
+    snaps = [l for l in lines[1:] if l["record"] == "snapshot"]
+    assert snaps, "close() must force a final snapshot"
+    final = snaps[-1]["metrics"]
+    assert final == [{"name": "c", "kind": "counter", "labels": {},
+                     "value": 5}]
+
+
+def test_metrics_writer_interval_rate_limits(tmp_path):
+    reg = MetricRegistry()
+    w = MetricsWriter(tmp_path / "m.jsonl", reg, {}, interval_s=3600.0)
+    assert w.maybe_flush()  # first flush always lands
+    assert not w.maybe_flush()  # inside the interval: suppressed
+    assert w.maybe_flush(force=True)
+    w.close()
+
+
+# -- serve lifecycle trace ---------------------------------------------------
+
+
+def test_derived_ttft_itl_equal_servestats_tp1_tp2():
+    """THE serve pin: TTFT/ITL derived purely from the request
+    lifecycle trace are EXACTLY (same floats) the ``ServeStats``
+    numbers, for tp=1 AND tp=2; warmup emits nothing; the registry
+    histograms/counters agree with ServeStats too."""
+    from ddl_tpu.serve import (
+        InferenceEngine,
+        Request,
+        Scheduler,
+        ServeConfig,
+        derive_request_slo,
+    )
+
+    prompts = synthesize_prompts(num=3, min_len=4, max_len=10,
+                                 vocab=SPEC.vocab, seed=0)
+    for tp in (1, 2):
+        eng = InferenceEngine(ServeConfig(
+            spec=SPEC, slots=2, capacity=64, tensor_parallel=tp,
+            prefix_slots=2,
+        ))
+        reqs = [Request(id=i, prompt=p, max_new_tokens=4, arrival=i)
+                for i, p in enumerate(prompts)]
+        tracer, reg = Tracer(), MetricRegistry()
+        sched = Scheduler(eng, tracer=tracer, registry=reg)
+        sched.warmup(reqs)
+        assert not tracer.records, "warmup telemetry must be suppressed"
+        done, stats = sched.run(reqs)
+        ttft, itl = derive_request_slo(tracer.records)
+        assert ttft == stats.ttft  # exact — same floats, not approx
+        assert itl == stats.itl
+        assert reg.histogram("serve_ttft_seconds").stats() == stats.ttft
+        assert reg.histogram("serve_itl_seconds").stats() == stats.itl
+        assert reg.counter("serve_prefill_tokens_total").value() \
+            == stats.prefill_tokens
+        assert reg.counter("serve_decode_tokens_total").value() \
+            == stats.decode_tokens
+        assert reg.counter("serve_requests_completed_total").value() == 3
+        names = {r["name"] for r in tracer.records}
+        assert {"submit", "eligible", "admit", "prefill_chunk",
+                "first_token", "decode_tick", "complete"} <= names
+        # Per-request lifecycle ordering: eligible <= admit <=
+        # first_token <= complete for every request id.
+        for rid in (0, 1, 2):
+            ts = {
+                name: next(r["t"] if "t" in r else r["t0"]
+                           for r in tracer.records
+                           if r["name"] == name
+                           and r["attrs"].get("req") == rid)
+                for name in ("eligible", "admit", "first_token", "complete")
+            }
+            assert ts["eligible"] <= ts["admit"] <= ts["first_token"] \
+                <= ts["complete"]
+
+
+# -- in-graph health vs jax.grad oracle -------------------------------------
+
+
+def _oracle(host_params, ds):
+    """Single-device global weighted-mean-loss gradient — the oracle
+    every distributed health grad_norm must reproduce."""
+    attn = functools.partial(ring.full_attention, causal=True)
+
+    def loss(p):
+        num, den = transformer.lm_loss_sums(
+            p, jnp.asarray(ds.tokens), jnp.asarray(ds.targets),
+            jnp.asarray(ds.weights), SPEC, attn_fn=attn,
+            positions=jnp.arange(T),
+        )
+        return num / den
+
+    g = jax.grad(loss)(host_params)
+    norm = jnp.sqrt(sum(
+        jnp.sum(jnp.square(a.astype(jnp.float32)))
+        for a in jax.tree.leaves(g)
+    ))
+    return float(norm)
+
+
+@pytest.fixture(scope="module")
+def health_ds():
+    return synthesize_copy(num_train=B, num_test=B, seq_len=T,
+                           vocab=SPEC.vocab, seed=0)
+
+
+@pytest.fixture(scope="module")
+def oracle_grad_norm(health_ds):
+    host = transformer.init_lm_params(jax.random.PRNGKey(0), SPEC)
+    return _oracle(host, health_ds)
+
+
+def _one_health_step(cfg, ds):
+    from ddl_tpu.strategies.seq import SeqTrainer
+
+    tr = SeqTrainer(cfg, ds)
+    xs = tr.stage_batches(ds.tokens, 1, B)
+    ys = tr.stage_batches(ds.targets, 1, B)
+    ws = tr.stage_batches(ds.weights, 1, B)
+    p, o, l, h = tr.span_program(1, health=True)(
+        tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0)
+    )
+    # The health-off program returns the plain triple with the same loss
+    # (the aux is an output, never a numerics change).
+    _, _, l_off = tr.span_program(1)(
+        jax.tree.map(jnp.copy, tr.params),
+        jax.tree.map(jnp.copy, tr.opt_state), xs, ys, ws, jnp.int32(0)
+    )
+    assert float(l) == float(l_off)
+    return {k: np.asarray(v)[0] for k, v in h.items()}
+
+
+def test_health_grad_norm_oracle_dp2_tp2(health_ds, oracle_grad_norm):
+    """The acceptance pin: replicated-step health on the dp2 x tp2 mesh
+    reproduces the single-device jax.grad oracle's global grad norm
+    (tp-sharded leaves' squared sums reduce over tp — a wrong/missing
+    psum would be off by ~sqrt(2) on the sharded subtree)."""
+    from ddl_tpu.strategies.seq import SeqConfig
+
+    h = _one_health_step(
+        SeqConfig(num_workers=1, data_parallel=2, tensor_parallel=2,
+                  scheme="full", batch_size=B, spec=SPEC),
+        health_ds,
+    )
+    assert float(h["grad_norm"]) == pytest.approx(oracle_grad_norm,
+                                                  rel=1e-4)
+    assert int(h["nonfinite_grads"]) == 0
+    host = transformer.init_lm_params(jax.random.PRNGKey(0), SPEC)
+    pn = float(jnp.sqrt(sum(
+        jnp.sum(jnp.square(a)) for a in jax.tree.leaves(host)
+    )))
+    assert float(h["param_norm"]) == pytest.approx(pn, rel=1e-4)
+    # Subtree norms compose to the global norm.
+    subs = [float(v) for k, v in h.items() if k.startswith("param_norm/")]
+    assert np.sqrt(np.sum(np.square(subs))) == pytest.approx(pn, rel=1e-4)
+    assert set(h) == set(hlt.health_keys(host))
+
+
+def test_health_grad_norm_oracle_zero1_and_hybrid(health_ds,
+                                                  oracle_grad_norm):
+    """The flat-chunk paths: zero1 (dp2 x sp2) and the hybrid
+    zero1 x tp (dp2 x sp2... tp2 on 8 devices) reproduce the same
+    oracle grad norm from their reduce-scattered chunks, with the SAME
+    health key set as the replicated mode."""
+    from ddl_tpu.strategies.seq import SeqConfig
+
+    h_z = _one_health_step(
+        SeqConfig(num_workers=2, data_parallel=2, scheme="ring",
+                  batch_size=B, zero1=True, spec=SPEC),
+        health_ds,
+    )
+    h_h = _one_health_step(
+        SeqConfig(num_workers=2, data_parallel=2, tensor_parallel=2,
+                  scheme="ring", batch_size=B, zero1=True, spec=SPEC),
+        health_ds,
+    )
+    for h in (h_z, h_h):
+        assert float(h["grad_norm"]) == pytest.approx(oracle_grad_norm,
+                                                      rel=1e-4)
+        assert int(h["nonfinite_grads"]) == 0
+    assert set(h_z) == set(h_h)
+
+
+def test_health_grad_norm_oracle_pipeline(health_ds, oracle_grad_norm):
+    """Pipeline pp=2: stage-resident block grads' squared sums reduce
+    over pp (spec-driven), shared leaves are already fully reduced —
+    the stacked-tree health matches the same oracle."""
+    from ddl_tpu.pipeline.trainer import make_pipeline_program
+    from ddl_tpu.strategies.seq import SeqConfig
+
+    cfg = SeqConfig(num_workers=1, scheme="full", batch_size=B, spec=SPEC,
+                    pipeline_parallel=2, microbatches=2)
+    fn, state = make_pipeline_program(
+        cfg, health_ds.tokens, health_ds.targets, health_ds.weights,
+        health=True,
+    )
+    _, _, _, h = fn(*state)
+    assert float(np.asarray(h["grad_norm"])) == pytest.approx(
+        oracle_grad_norm, rel=1e-4
+    )
+    assert int(np.asarray(h["nonfinite_grads"])) == 0
+
+
+def test_record_health_into_registry():
+    """record_health: last-step gauges (subtree-labelled), span-summed
+    non-finite counter."""
+    reg = MetricRegistry()
+    hlt.record_health(reg, {
+        "grad_norm": np.array([1.0, 2.0]),
+        "nonfinite_grads": np.array([1, 3], np.int32),
+        "param_norm": np.array([5.0, 6.0]),
+        "update_norm": np.array([0.5, 0.25]),
+        "param_norm/blocks": np.array([4.0, 4.5]),
+        "update_norm/blocks": np.array([0.4, 0.2]),
+    })
+    assert reg.gauge("train_grad_norm").value() == 2.0
+    assert reg.gauge("train_param_norm").value(subtree="blocks") == 4.5
+    assert reg.gauge("train_update_norm").value() == 0.25
+    assert reg.counter("train_nonfinite_grads_total").value() == 4
+    # The trainers' split: the tripwire counter moves on EVERY span
+    # (record_nonfinite), the gauges only on interval-crossing spans
+    # (record_health with include_nonfinite=False — no double count).
+    hlt.record_nonfinite(reg, np.array([2, 0], np.int32))
+    assert reg.counter("train_nonfinite_grads_total").value() == 6
+    hlt.record_health(reg, {
+        "grad_norm": np.array([3.0]),
+        "nonfinite_grads": np.array([9], np.int32),
+        "param_norm": np.array([5.0]),
+        "update_norm": np.array([0.5]),
+    }, include_nonfinite=False)
+    assert reg.counter("train_nonfinite_grads_total").value() == 6
+    assert reg.gauge("train_grad_norm").value() == 3.0
+
+
+def test_health_keys_static_and_spec_tree_safe():
+    """health_keys works on value trees, shapes-only templates AND
+    PartitionSpec trees (P is a tuple subclass — must be a leaf)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.models.partition import lm_param_specs, pipeline_param_specs
+
+    host = transformer.init_lm_params(jax.random.PRNGKey(0), SPEC)
+    keys = hlt.health_keys(host)
+    assert hlt.health_keys(jax.eval_shape(lambda: host)) == keys
+    assert hlt.health_keys(lm_param_specs(SPEC, 2)) == keys
+    assert hlt.health_keys(pipeline_param_specs(SPEC, 2, 1)) == keys
+    assert hlt.health_out_specs(host) == {k: P() for k in keys}

@@ -100,6 +100,34 @@ def test_step_stats_percentiles_n1_n2_edges():
     assert s2.p50_ms < s2.p95_ms < s2.p99_ms < 30.0
 
 
+def test_step_stats_empty_constructs_all_fields_explicitly():
+    """The n=0 StepStats (ISSUE 5 satellite): every field pinned to
+    exactly zero BY NAME — the old positional 6-tuple silently leaned
+    on the p99_ms default, one field reorder away from assigning a
+    percentile into total_s."""
+    from ddl_tpu.utils.metrics import StepStats
+
+    z = StepStats.from_times([])
+    assert (z.steps, z.mean_ms, z.p50_ms, z.p95_ms, z.p99_ms,
+            z.total_s, z.images_per_sec) == (0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
+    assert z == StepStats(steps=0, mean_ms=0.0, p50_ms=0.0, p95_ms=0.0,
+                          p99_ms=0.0, total_s=0.0, images_per_sec=0.0)
+
+
+def test_step_stats_tokens_per_sec_alias_and_line_unit():
+    """``tokens_per_sec`` is the honestly-named read of the throughput
+    field for the token-counting paths (LM/serve), and ``line()`` can
+    label the unit (ISSUE 5 satellite — token throughput was reported
+    under the misnamed img/s)."""
+    from ddl_tpu.utils.metrics import StepStats
+
+    s = StepStats.from_times([0.5, 0.5], images=[100, 100])
+    assert s.images_per_sec == pytest.approx(200.0)
+    assert s.tokens_per_sec == s.images_per_sec
+    assert s.line().endswith("200 img/s")
+    assert s.line(unit="tok/s").endswith("200 tok/s")
+
+
 def test_step_stats_warmup_exclusion_and_empty():
     """Warmup steps leave the percentile window (but stay in total_s,
     the throughput bracket); an all-warmup timer yields the zero
