@@ -130,9 +130,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
                    help="additionally checkpoint every N batches "
                         "(async: N rounds); 0 = epoch end only")
-    p.add_argument("--resume", action="store_true",
-                   help="resume from the checkpoint in --checkpoint-dir "
-                        "(missing checkpoint starts fresh)")
+    p.add_argument("--resume", nargs="?", const="latest", default=None,
+                   choices=["latest", "auto"], metavar="MODE",
+                   help="resume from --checkpoint-dir: bare --resume (or "
+                        "'latest') loads the rolling checkpoint exactly; "
+                        "'auto' discovers the newest VALID save — corrupt "
+                        "or truncated files are checksum-verified out and "
+                        "resume falls back to the previous retained one "
+                        "(missing checkpoint starts fresh either way)")
+    p.add_argument("--max-bad-steps", type=int, default=None, metavar="K",
+                   help="single/lm: compile the NaN-guarded train step "
+                        "(a step with non-finite gradients applies "
+                        "identity in-graph — no crash, no divergence "
+                        "poisoning the optimizer state) and roll back to "
+                        "the last good checkpoint after K CONSECUTIVE "
+                        "skipped steps, replaying from its step "
+                        "(requires --checkpoint-dir)")
+    p.add_argument("--inject-fault", default=None, metavar="SPEC",
+                   help="deterministic chaos (ddl_tpu.resilience.faults): "
+                        "train (single/lm): nan_grads@K[xN] / "
+                        "inf_grads@K[xN] (poison N batches' data from "
+                        "global step K; append '!' to persist through "
+                        "rollbacks), sigterm@K (real SIGTERM once step K "
+                        "completes), corrupt_ckpt / truncate_ckpt (damage "
+                        "the latest checkpoint at startup, then prove "
+                        "--resume auto); serve: stall@REQID (never "
+                        "advance that request's prefill — its deadline "
+                        "must evict it)")
     p.add_argument("--dispatch-timeout", type=float, default=0.0,
                    metavar="SECONDS",
                    help="fail with a diagnosis (instead of hanging forever) "
@@ -310,6 +334,26 @@ def build_parser() -> argparse.ArgumentParser:
                     help="max prefill tokens per scheduler tick when "
                          "chunking (>= --prefill-chunk); 0 = one chunk "
                          "per tick, the maximum-interleaving default")
+    sv.add_argument("--ttft-deadline", type=float, default=None,
+                    metavar="SECONDS",
+                    help="default per-request time-to-first-token "
+                         "deadline: a request not decoding within "
+                         "SECONDS of becoming eligible is evicted with "
+                         "status 'deadline_exceeded' (slot freed, "
+                         "prefix refs released)")
+    sv.add_argument("--request-deadline", type=float, default=None,
+                    metavar="SECONDS",
+                    help="default per-request TOTAL deadline "
+                         "(eligibility to completion); expiry returns "
+                         "the partial tokens with status "
+                         "'deadline_exceeded'")
+    sv.add_argument("--shed-threshold", type=int, default=None, metavar="N",
+                    help="admission shedding: a request whose first "
+                         "eligible tick finds N outstanding requests "
+                         "(occupied slots + waiting eligibles) is "
+                         "refused with status 'shed' instead of "
+                         "collapsing admitted traffic's ITL; must be "
+                         ">= --slots")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -480,22 +524,29 @@ def _ensure_devices(n: int, *, allow_fallback: bool = True,
 
 def _install_sigterm_flag(enabled: bool) -> dict:
     """Graceful preemption (preemptible TPU VMs send SIGTERM before
-    reclaim): finish the in-flight span, save the rolling checkpoint,
-    exit 0 — a later --resume run continues where this one stopped.
-    Returns the flag dict the trainer's ``should_stop`` closes over."""
+    reclaim; an operator's Ctrl-C is the same intent): finish the
+    in-flight span, save the rolling checkpoint, flush the metrics
+    writer/tracer (the CLI's ``finally`` blocks), exit 0 — a later
+    --resume run continues where this one stopped. Returns the flag
+    dict the trainer's ``should_stop`` closes over."""
     term = {"flag": False}
     if enabled:
         import signal
 
-        def _on_term(signum, frame):
-            # Flag only — no IO in the handler (a print here can hit
-            # CPython's reentrant-BufferedWriter guard and kill the run
-            # uncheckpointed). Restoring SIG_DFL lets a second SIGTERM
-            # terminate promptly if the grace window is too short.
-            term["flag"] = True
-            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        def _handler_for(signum):
+            def _on_sig(sig, frame):
+                # Flag only — no IO in the handler (a print here can hit
+                # CPython's reentrant-BufferedWriter guard and kill the
+                # run uncheckpointed). Restoring the default lets a
+                # second delivery terminate promptly if the grace
+                # window is too short.
+                term["flag"] = True
+                signal.signal(signum, signal.SIG_DFL)
 
-        signal.signal(signal.SIGTERM, _on_term)
+            return _on_sig
+
+        signal.signal(signal.SIGTERM, _handler_for(signal.SIGTERM))
+        signal.signal(signal.SIGINT, _handler_for(signal.SIGINT))
     return term
 
 
@@ -530,11 +581,12 @@ _TRAIN_ONLY_DESTS = (
     "pipeline_parallel", "microbatches", "pipeline_schedule",
     "num_workers", "epochs", "batch_size", "lr", "eval_every",
     "checkpoint_every", "resume", "dispatch_timeout", "profile",
+    "max_bad_steps",
 )
 _SERVE_ONLY_DESTS = (
     "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
     "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
-    "prefill_budget",
+    "prefill_budget", "ttft_deadline", "request_deadline", "shed_threshold",
 )
 
 
@@ -563,6 +615,57 @@ def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
 
         tracer = Tracer(host_trace_file(args.trace_dir))
     return registry, writer, tracer
+
+
+def _make_injector(args, variant: str):
+    """Resolve ``--inject-fault`` for this variant: validates the
+    kind/variant pairing, applies startup checkpoint chaos
+    (corrupt/truncate the latest save in --checkpoint-dir — pair with
+    ``--resume auto`` to prove recovery), and returns a runtime
+    ``FaultInjector`` for the kinds the trainer/scheduler consumes
+    (None when no runtime fault is armed)."""
+    if not args.inject_fault:
+        return None
+    from .resilience import faults
+
+    try:
+        spec = faults.parse_fault(args.inject_fault)
+    except ValueError as e:
+        raise SystemExit(f"--inject-fault: {e}")
+    if spec.kind in faults.SERVE_KINDS:
+        if variant != "serve":
+            raise SystemExit(
+                f"--inject-fault {spec.kind} applies to the serve variant"
+            )
+        return faults.FaultInjector(spec)
+    if variant not in ("single", "lm"):
+        raise SystemExit(
+            f"--inject-fault {spec.kind} applies to the single/lm "
+            "variants (the guarded trainers)"
+        )
+    if spec.kind in faults.CKPT_KINDS:
+        from .train.trainer import checkpoint_file
+        from .utils.checkpoint import find_latest_valid
+
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                f"--inject-fault {spec.kind} needs --checkpoint-dir"
+            )
+        found = find_latest_valid(args.checkpoint_dir)
+        target = found[0] if found else checkpoint_file(args.checkpoint_dir)
+        import os
+
+        if not os.path.exists(target):
+            raise SystemExit(
+                f"--inject-fault {spec.kind}: no checkpoint at {target}"
+            )
+        if spec.kind == "corrupt_ckpt":
+            faults.corrupt_checkpoint(target, seed=args.seed)
+        else:
+            faults.truncate_checkpoint(target)
+        print(f"[ddl_tpu] chaos: {spec.kind} applied to {target}")
+        return None
+    return faults.FaultInjector(spec)
 
 
 def _reject_foreign_flags(args, variant: str, dests) -> None:
@@ -656,6 +759,7 @@ def _run_lm(args) -> int:
     )
     from .parallel.mesh import AcceleratorTimeout
 
+    injector = _make_injector(args, "lm")
     term = _install_sigterm_flag(bool(args.checkpoint_dir))
     try:
         dataset = synthesize_copy(
@@ -686,6 +790,8 @@ def _run_lm(args) -> int:
             metrics_interval=args.metrics_interval,
             metrics_writer=writer,
             tracer=tracer,
+            max_bad_steps=args.max_bad_steps or 0,
+            fault_injector=injector,
         )
         if registry is not None:
             registry.gauge("train_final_accuracy").set(result.final_accuracy)
@@ -721,6 +827,8 @@ def _run_lm(args) -> int:
                           if result.step_stats else None,
             "resumed_from_step": result.resumed_from_step,
             "preempted": result.preempted,
+            "skipped_steps": result.skipped_steps,
+            "rollbacks": result.rollbacks,
         }))
     return 0
 
@@ -813,7 +921,17 @@ def _run_serve(args) -> int:
     registry, writer, _ = _build_obs(
         args, config=cfg, mesh=engine.mesh, make_tracer=False
     )
-    scheduler = Scheduler(engine, registry=registry, metrics_writer=writer)
+    injector = _make_injector(args, "serve")
+    try:
+        scheduler = Scheduler(
+            engine, registry=registry, metrics_writer=writer,
+            ttft_deadline_s=args.ttft_deadline,
+            deadline_s=args.request_deadline,
+            shed_threshold=args.shed_threshold,
+            injector=injector,
+        )
+    except ValueError as e:
+        raise SystemExit(f"serve config error: {e}")
     # Compile outside the reported run: the printed/JSON latency
     # percentiles and tok/s must measure serving, not jit (the shared
     # serve_bench/BASELINE.md methodology). Warmup also suppresses
@@ -834,9 +952,10 @@ def _run_serve(args) -> int:
             writer.close()
     for i in sorted(done):
         c = done[i]
+        tag = "" if c.status == "ok" else f" [{c.status}]"
         print(f"request {i}: prompt {c.prompt_len} tokens -> "
               f"{len(c.tokens)} generated {c.tokens[:8]}"
-              f"{'...' if len(c.tokens) > 8 else ''}")
+              f"{'...' if len(c.tokens) > 8 else ''}{tag}")
     lat = stats.latency
     print(f"prefill {stats.prefill_tokens_per_s:.0f} tok/s | decode "
           f"{stats.decode_tokens_per_s_per_slot:.1f} tok/s/slot "
@@ -856,7 +975,8 @@ def _run_serve(args) -> int:
             "max_new_tokens": args.max_new_tokens,
             "completions": {
                 str(i): {"prompt_len": done[i].prompt_len,
-                         "tokens": done[i].tokens}
+                         "tokens": done[i].tokens,
+                         "status": done[i].status}
                 for i in sorted(done)
             },
             "prefill_tokens_per_s": stats.prefill_tokens_per_s,
@@ -891,6 +1011,26 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit("--metrics-interval requires --metrics-out")
     else:
         args.metrics_interval = 10
+    if args.max_bad_steps is not None:
+        if args.max_bad_steps < 1:
+            raise SystemExit(
+                f"--max-bad-steps must be >= 1, got {args.max_bad_steps}"
+            )
+        if args.variant not in ("single", "lm"):
+            raise SystemExit(
+                "--max-bad-steps applies to the single/lm variants (the "
+                "guarded trainers)"
+            )
+        if not args.checkpoint_dir:
+            # Rollback needs a checkpoint to roll back TO; failing at
+            # the trip (mid-run) would waste the whole run.
+            raise SystemExit(
+                "--max-bad-steps rollback requires --checkpoint-dir"
+            )
+    if args.inject_fault and args.variant not in ("single", "lm", "serve"):
+        raise SystemExit(
+            "--inject-fault applies to the single/lm/serve variants"
+        )
     if args.platform:
         import jax
 
@@ -1009,6 +1149,8 @@ def main(argv: list[str] | None = None) -> int:
         obs_kwargs = dict(
             metrics=registry, metrics_interval=args.metrics_interval,
             metrics_writer=writer, tracer=tracer,
+            max_bad_steps=args.max_bad_steps or 0,
+            fault_injector=_make_injector(args, "single"),
         )
     elif tracer is not None:
         # sync/async: the trainers take no tracer, but --trace-dir must
@@ -1072,6 +1214,8 @@ def main(argv: list[str] | None = None) -> int:
                           if result.step_stats else None,
             "resumed_from_step": result.resumed_from_step,
             "preempted": result.preempted,
+            "skipped_steps": result.skipped_steps,
+            "rollbacks": result.rollbacks,
         }))
     return 0
 

@@ -136,6 +136,22 @@ def health_out_specs(template) -> dict:
     return {k: P() for k in health_keys(template)}
 
 
+def nonfinite_count(grads, pspecs) -> jax.Array:
+    """Global non-finite ELEMENT count (int32, replicated) of a gradient
+    tree whose leaves are complete up to the sharding ``pspecs``
+    describes — the divergence-tripwire scalar, exposed standalone so
+    the ISSUE-6 step guard can compute ONLY it (no norm FLOPs) when
+    metrics are off."""
+    nf_local: dict[tuple, jax.Array] = {}
+    for _, leaf, axes in _leaves_with_specs(grads, pspecs):
+        n = jnp.sum(~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.int32)
+        nf_local[axes] = nf_local.get(axes, jnp.int32(0)) + n
+    nf = jnp.int32(0)
+    for axes, n in nf_local.items():
+        nf = nf + (lax.psum(n, axes) if axes else n)
+    return nf
+
+
 def grad_signals(grads, pspecs) -> dict[str, jax.Array]:
     """``grad_norm`` + ``nonfinite_grads`` from a FULL gradient tree
     whose leaves are complete up to the sharding ``pspecs`` describes
@@ -144,14 +160,8 @@ def grad_signals(grads, pspecs) -> dict[str, jax.Array]:
     total = jnp.float32(0.0)
     for sq in _grouped_sq(entries).values():
         total = total + sq
-    nf_local: dict[tuple, jax.Array] = {}
-    for _, leaf, axes in entries:
-        n = jnp.sum(~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.int32)
-        nf_local[axes] = nf_local.get(axes, jnp.int32(0)) + n
-    nf = jnp.int32(0)
-    for axes, n in nf_local.items():
-        nf = nf + (lax.psum(n, axes) if axes else n)
-    return {"grad_norm": jnp.sqrt(total), "nonfinite_grads": nf}
+    return {"grad_norm": jnp.sqrt(total),
+            "nonfinite_grads": nonfinite_count(grads, pspecs)}
 
 
 def norm_signals(params, new_params, pspecs) -> dict[str, jax.Array]:

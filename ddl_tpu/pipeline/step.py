@@ -124,7 +124,7 @@ def make_stage_fn(config, platform):
 
 
 def make_pipeline_step_body(config, part, tables, platform, *, lr,
-                            health: bool = False):
+                            health: bool = False, guard: bool = False):
     """One pipeline train step, already inside ``shard_map``
     (``check_vma=False``, local-grads mode):
     ``(params, opt, tokens, targets, weights) -> (params, opt, loss)``.
@@ -246,22 +246,38 @@ def make_pipeline_step_body(config, part, tables, platform, *, lr,
             for k, g in gacc.items()
         }
         new_params, new_opt = adam_update(params, opt_state, grads, lr=lr)
-        if not health:
-            return new_params, new_opt, loss
-        # In-graph health (obs.health, ISSUE 5): the stacked-block
-        # leaves are stage-resident over pp (and Megatron-sharded over
-        # tp), so their squared sums reduce over exactly the axes their
-        # PartitionSpec names; the pp-replicated shared leaves are
-        # already fully reduced. Python-level flag: health=False
-        # compiles the exact pre-observability program.
-        from ..models.partition import pipeline_param_specs
-        from ..obs import health as hlt
+        out = ()
+        if guard or health:
+            # Both flags key off the same PartitionSpec-driven
+            # reductions (obs.health, ISSUE 5): the stacked-block
+            # leaves are stage-resident over pp (and Megatron-sharded
+            # over tp), so their counts/squared sums reduce over
+            # exactly the axes their PartitionSpec names; the
+            # pp-replicated shared leaves are already fully reduced.
+            # Python-level flags: health=False, guard=False compiles
+            # the exact pre-change program.
+            from ..models.partition import pipeline_param_specs
+            from ..obs import health as hlt
 
-        pspecs = pipeline_param_specs(
-            config.spec, part.pp, config.tensor_parallel
-        )
-        h = hlt.health_signals(grads, params, new_params, pspecs)
-        return new_params, new_opt, loss, h
+            pspecs = pipeline_param_specs(
+                config.spec, part.pp, config.tensor_parallel
+            )
+        if guard:
+            # ISSUE 6 step guard: identity instead of the Adam update
+            # when ANY stage's gradients went non-finite (the count is
+            # globally reduced, so every pp/tp position selects the
+            # same branch); the int32 skip flag rides as LAST output.
+            from ..resilience.guard import apply_guard
+
+            new_params, new_opt, skipped = apply_guard(
+                hlt.nonfinite_count(grads, pspecs),
+                params, opt_state, new_params, new_opt,
+            )
+            out = (skipped,)
+        if health:
+            h = hlt.health_signals(grads, params, new_params, pspecs)
+            out = (h,) + out
+        return (new_params, new_opt, loss) + out
 
     return step
 

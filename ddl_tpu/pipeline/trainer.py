@@ -31,7 +31,8 @@ from .schedule import schedule_tables
 from .step import make_pipeline_eval_body, make_pipeline_step_body
 
 
-def pipeline_shard_step(config, mesh, platform, health: bool = False):
+def pipeline_shard_step(config, mesh, platform, health: bool = False,
+                        guard: bool = False):
     """The ``shard_map``'d pipeline train step for this config on this
     4-D mesh: ``(params, opt, tokens, targets, weights) ->
     (params, opt, loss)`` with train batches ``P(dp, sp)`` (sp is size
@@ -39,14 +40,15 @@ def pipeline_shard_step(config, mesh, platform, health: bool = False):
     state placed like the params. ``check_vma=False`` — local-grads
     mode, every reduction explicit in the body (pipeline.step).
     ``health=True`` appends the in-graph health dict (``obs.health``)
-    as a fourth, fully-reduced output."""
+    as a fourth, fully-reduced output; ``guard=True`` (ISSUE 6) the
+    NaN-guarded update plus the int32 skip flag as LAST output."""
     part = stage_partition(config.spec, config.pipeline_parallel)
     tables = schedule_tables(
         config.pipeline_schedule, part.pp, config.microbatches
     )
     body = make_pipeline_step_body(
         config, part, tables, platform, lr=config.learning_rate,
-        health=health,
+        health=health, guard=guard,
     )
     pspecs = pipeline_param_specs(
         config.spec, part.pp, config.tensor_parallel
@@ -58,6 +60,8 @@ def pipeline_shard_step(config, mesh, platform, health: bool = False):
         from ..obs import health as hlt
 
         out_specs = out_specs + (hlt.health_out_specs(pspecs),)
+    if guard:
+        out_specs = out_specs + (P(),)
     return jax.shard_map(
         body,
         mesh=mesh,

@@ -1,0 +1,31 @@
+"""Fault tolerance for training and serving (ISSUE 6).
+
+Three layers, each provable under test via the deterministic fault
+injector (``resilience.faults``):
+
+- **In-graph step guard** (``resilience.guard``): when a step's fully
+  reduced gradients contain ANY non-finite element (the ISSUE-5
+  ``nonfinite_grads`` tripwire), the jitted step applies IDENTITY
+  instead of the optimizer update — a ``jnp.where`` select over the
+  param/opt-state pytrees, no host sync, no recompile. A host-side
+  ``GuardMonitor`` escalates: K consecutive skipped steps roll the
+  trainer back to the last good checkpoint and re-seed the data stream
+  to the rolled-back step.
+- **Checkpoint hardening** (``utils.checkpoint``): fsync'd atomic
+  writes, per-array-checksum manifests, last-N retention, and
+  ``find_latest_valid`` auto-resume discovery that skips corrupt or
+  truncated saves (``--resume auto``).
+- **Serve robustness** (``serve.scheduler``): per-request TTFT/total
+  deadlines (expiry evicts the slot and releases pinned prefix refs)
+  and queue-depth admission shedding, both returned as structured
+  ``Completion`` statuses so overload degrades instead of collapsing.
+"""
+
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    corrupt_checkpoint,
+    parse_fault,
+    truncate_checkpoint,
+)
+from .guard import GuardMonitor, apply_guard  # noqa: F401

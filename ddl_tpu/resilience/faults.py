@@ -1,0 +1,198 @@
+"""Deterministic fault injection — the proof harness for every recovery
+path (ISSUE 6 tentpole piece 4).
+
+A resilience feature that has only ever run in an outage is untested
+code; this module makes every failure mode a SEEDED, REPRODUCIBLE input
+so the test suite (tests/test_resilience.py) and manual chaos runs
+(CLI ``--inject-fault``) exercise the exact paths production will:
+
+- ``nan_grads@K`` / ``inf_grads@K`` — poison ONE element of the staged
+  loss weights (LM) or input images (CNN) for the batch at global step
+  ``K`` (``@KxN`` poisons ``N`` consecutive batches). The forward then
+  produces a non-finite loss and non-finite gradients NATURALLY —
+  the injection exercises the real tripwire/guard path, not a mock.
+  Transient by default (``once=True``): a guard rollback heals the
+  data, modelling an SDC/HW blip; ``once=False`` models persistently
+  bad data (the rollback bound must then trip).
+- ``sigterm@K`` — deliver a REAL ``SIGTERM`` to this process once
+  global step ``K`` completes (the preemption notice a TPU VM gets),
+  driving the graceful drain → final checkpoint → clean exit path.
+- ``corrupt_ckpt`` / ``truncate_ckpt`` — flip bytes in / truncate a
+  checkpoint file (deterministic under ``seed``), the torn-write and
+  bit-rot inputs ``find_latest_valid`` must survive.
+- ``stall@RID`` — the serve scheduler never advances request ``RID``'s
+  prefill (an upstream hang), so its deadline must evict it and release
+  its pinned prefix refs.
+
+Injection is host-side only — staged data, signals, files — so the
+compiled programs under test are the production programs, bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+
+import numpy as np
+
+TRAIN_KINDS = ("nan_grads", "inf_grads", "sigterm")
+CKPT_KINDS = ("corrupt_ckpt", "truncate_ckpt")
+SERVE_KINDS = ("stall",)
+KINDS = TRAIN_KINDS + CKPT_KINDS + SERVE_KINDS
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic fault. ``step`` is the trigger global step
+    (train kinds) or the target request id (``stall``); ``count``
+    extends a grad fault over consecutive batches; ``once=True`` makes
+    a grad fault transient (healed by a guard rollback)."""
+
+    kind: str
+    step: int = 0
+    count: int = 1
+    once: bool = True
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (choices: "
+                f"{', '.join(KINDS)})"
+            )
+        if self.step < 0 or self.count < 1:
+            raise ValueError(
+                f"fault {self.kind}: need step >= 0 and count >= 1, got "
+                f"step={self.step} count={self.count}"
+            )
+
+
+def parse_fault(text: str) -> FaultSpec:
+    """CLI syntax: ``kind``, ``kind@STEP`` or ``kind@STEPxCOUNT`` —
+    e.g. ``nan_grads@3``, ``nan_grads@3x2``, ``sigterm@5``,
+    ``stall@7``, ``corrupt_ckpt``. A trailing ``!`` makes a grad fault
+    persistent (``once=False``): ``nan_grads@3x2!``."""
+    once = True
+    if text.endswith("!"):
+        once = False
+        text = text[:-1]
+    kind, at, rest = text.partition("@")
+    step, count = 0, 1
+    if at:
+        head, x, tail = rest.partition("x")
+        try:
+            step = int(head)
+            count = int(tail) if x else 1
+        except ValueError:
+            raise ValueError(
+                f"bad fault spec {text!r}: expected kind@STEP or "
+                "kind@STEPxCOUNT with integer STEP/COUNT"
+            )
+    return FaultSpec(kind=kind, step=step, count=count, once=once)
+
+
+class FaultInjector:
+    """Stateful host-side delivery of one :class:`FaultSpec`.
+
+    Trainers call :meth:`poison_batches` while staging data and
+    :meth:`maybe_sigterm` at span boundaries; a guard rollback calls
+    :meth:`heal` (True = restage clean data). The serve scheduler asks
+    :meth:`stalls` per slot per tick. All decisions are pure functions
+    of the spec + the healed flag — rerunning the same spec reproduces
+    the same incident."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self.healed = False
+        self._sigterm_fired = False
+
+    # -- training: data poisoning -----------------------------------------
+
+    def poisons_data(self) -> bool:
+        return self.spec.kind in ("nan_grads", "inf_grads") \
+            and not self.healed
+
+    def poison_batches(self, arr: np.ndarray, batch_num: int,
+                       batch_size: int) -> np.ndarray:
+        """Copy of the 2-D host array ``[N, ...]`` (rows are examples,
+        sequential batching: batch ``b`` = rows ``[b*bs, (b+1)*bs)``)
+        with one element of each targeted batch's first row set
+        non-finite. Targets are the batch indices of global steps
+        ``step .. step+count-1`` (mod ``batch_num`` — data repeats per
+        epoch, so a poisoned batch is poisoned on every epoch pass
+        until healed). No-op (returns ``arr``) when not armed."""
+        if not self.poisons_data() or batch_num < 1:
+            return arr
+        value = np.nan if self.spec.kind == "nan_grads" else np.inf
+        out = np.array(arr, copy=True)
+        for i in range(self.spec.count):
+            b = (self.spec.step + i) % batch_num
+            row = b * batch_size
+            if row < out.shape[0]:
+                out.reshape(out.shape[0], -1)[row, 0] = value
+        return out
+
+    def heal(self) -> bool:
+        """Called by the trainer after a guard rollback: a transient
+        (``once=True``) data fault clears — the trainer restages clean
+        data when this returns True."""
+        if self.poisons_data() and self.spec.once:
+            self.healed = True
+            return True
+        return False
+
+    # -- training: preemption ----------------------------------------------
+
+    def maybe_sigterm(self, completed_gstep: int) -> None:
+        """Deliver one real SIGTERM to this process once training has
+        completed global step ``spec.step`` (called at span boundaries —
+        delivery granularity is a span, exactly like a real notice)."""
+        if self.spec.kind != "sigterm" or self._sigterm_fired:
+            return
+        if completed_gstep > self.spec.step:
+            self._sigterm_fired = True
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- serving -----------------------------------------------------------
+
+    def stalls(self, request_id: int) -> bool:
+        """True while request ``request_id``'s prefill must not advance
+        (``stall`` faults; ``spec.step`` holds the target id)."""
+        return self.spec.kind == "stall" and not self.healed \
+            and request_id == self.spec.step
+
+
+# -- checkpoint chaos ---------------------------------------------------------
+
+
+def corrupt_checkpoint(path: str | os.PathLike, *, seed: int = 0,
+                       nbytes: int = 64) -> None:
+    """Deterministically flip ``nbytes`` bytes in the middle of
+    ``path`` IN PLACE (bit rot / partial overwrite). The file keeps its
+    size, so only content verification — the manifest checksums, or a
+    failed zip read — can catch it."""
+    size = os.path.getsize(path)
+    rng = np.random.default_rng(seed)
+    with open(path, "r+b") as f:
+        start = max(size // 2 - nbytes // 2, 0)
+        f.seek(start)
+        chunk = bytearray(f.read(min(nbytes, size - start)))
+        for i in range(len(chunk)):
+            chunk[i] ^= int(rng.integers(1, 256))
+        f.seek(start)
+        f.write(bytes(chunk))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def truncate_checkpoint(path: str | os.PathLike, *, frac: float = 0.5) -> None:
+    """Truncate ``path`` to ``frac`` of its size IN PLACE — the torn
+    write a preemption mid-save produces on non-atomic writers (ours is
+    atomic; this models an externally damaged file)."""
+    if not 0 <= frac < 1:
+        raise ValueError(f"frac must be in [0, 1), got {frac}")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(int(size * frac))
+        f.flush()
+        os.fsync(f.fileno())

@@ -1,0 +1,182 @@
+"""NaN-guarded step skipping with host-side rollback escalation.
+
+Device half (:func:`apply_guard`): inside a jitted step body, AFTER the
+optimizer produced its proposed ``(new_params, new_opt)``, select the
+OLD state whenever the step's fully reduced gradient contained a
+non-finite element — a scalar-predicate ``jnp.where`` broadcast over
+every pytree leaf. The predicate is the same ``nonfinite_grads`` count
+the ISSUE-5 health tripwire computes (psum'd per each leaf's
+PartitionSpec axes, so it is replicated and every device takes the SAME
+branch), which means the guard adds no collective of its own beyond
+that count. No host sync, no recompile: the skip happens entirely
+in-graph, and ``guard=False`` is a Python-level branch in every step
+body, so the default program is byte-identical to the pre-guard one
+(the same discipline as ``health=False``).
+
+Host half (:class:`GuardMonitor`): trainers fetch the span's ``[k]``
+stacked skip flags on the loss barrier (a handful of int32s — no added
+sync) and feed them here. The monitor counts total and CONSECUTIVE
+skips; ``max_bad_steps`` consecutive skips trip ESCALATION — the
+trainer rolls back to the newest valid checkpoint at or before the
+streak's first bad step (``utils.checkpoint.find_latest_valid`` with
+``max_step``) and re-enters its span loop there, which re-seeds the
+data stream to the rolled-back step (batches are indexed by global
+step, so position IS the seed). ``max_rollbacks`` bounds the retry loop
+— a persistent fault (bad data, a real divergence) raises instead of
+cycling forever.
+
+Everything is observable: skips and rollbacks land on the ISSUE-5
+registry (``train_skipped_steps_total``, ``train_rollbacks_total``) and
+tracer (``guard_skip`` / ``guard_rollback`` events), so an incident is
+auditable from the run's telemetry alone.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rollback_state(checkpoint_dir, monitor: "GuardMonitor", like, log):
+    """The trainer-agnostic half of a guard rollback (SeqTrainer and
+    SingleChipTrainer share it; only array placement differs per
+    trainer): locate the newest VALID checkpoint at or before the
+    divergence streak's first bad step, load it in checkpoint (host)
+    form, and prune every retained save NEWER than it — those describe
+    the abandoned timeline and must not win a later ``--resume auto``
+    race. Returns ``(host_tree, step)``; raises with a diagnosis when
+    there is nothing to roll back to."""
+    from ..utils.checkpoint import (
+        discard_newer,
+        find_latest_valid,
+        load_checkpoint,
+    )
+
+    if checkpoint_dir is None:
+        raise RuntimeError(
+            "guard escalation tripped (max_bad_steps consecutive "
+            "non-finite steps) but no checkpoint_dir is set — nothing "
+            "to roll back to"
+        )
+    found = find_latest_valid(
+        checkpoint_dir, max_step=monitor.streak_start, log=log
+    )
+    if found is None:
+        raise RuntimeError(
+            "guard escalation tripped but no valid checkpoint at or "
+            f"before step {monitor.streak_start} exists under "
+            f"{checkpoint_dir}"
+        )
+    path, _ = found
+    tree, step, _ = load_checkpoint(path, like)
+    step = int(step or 0)
+    discard_newer(checkpoint_dir, step, log=log)
+    log(f"[guard] rolled back to checkpoint step {step} ({path})")
+    return tree, step
+
+
+def apply_guard(nonfinite, params, opt_state, new_params, new_opt):
+    """In-graph identity-on-divergence select (see module docstring).
+
+    ``nonfinite`` is the step's REPLICATED non-finite gradient element
+    count (int32 scalar). Returns ``(params', opt', skipped)`` where the
+    primed trees are the proposed update when the gradients were finite
+    and the UNCHANGED inputs otherwise, and ``skipped`` is an int32
+    0/1 scalar (stacked per step by the span scan, fetched by the
+    trainer for the escalation policy)."""
+    bad = nonfinite > 0
+    keep = lambda old, new: jnp.where(bad, old, new)
+    return (
+        jax.tree.map(keep, params, new_params),
+        jax.tree.map(keep, opt_state, new_opt),
+        bad.astype(jnp.int32),
+    )
+
+
+class GuardMonitor:
+    """Host-side escalation policy over the guard's per-step skip flags.
+
+    ``observe(skipped_stack, first_gstep)`` consumes one span's stacked
+    flags and returns True when ``max_bad_steps`` CONSECUTIVE skips have
+    accumulated (0 disables escalation — skip-only guard). After the
+    trainer rolls back it calls :meth:`rolled_back`, which resets the
+    streak and enforces ``max_rollbacks``. ``streak_start`` is the
+    global step of the current streak's first skip — the rollback upper
+    bound (a checkpoint saved DURING the streak embeds skipped steps
+    and is not "good")."""
+
+    def __init__(self, max_bad_steps: int = 0, *, max_rollbacks: int = 3,
+                 registry=None, tracer=None):
+        if max_bad_steps < 0:
+            raise ValueError(
+                f"max_bad_steps must be >= 0, got {max_bad_steps}"
+            )
+        if max_rollbacks < 1:
+            raise ValueError(
+                f"max_rollbacks must be >= 1, got {max_rollbacks}"
+            )
+        self.max_bad_steps = max_bad_steps
+        self.max_rollbacks = max_rollbacks
+        self.registry = registry
+        self.tracer = tracer
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self.consecutive = 0
+        self.streak_start: int | None = None
+
+    def observe(self, skipped_stack, first_gstep: int) -> bool:
+        """Feed one span's ``[k]`` skip flags (host ints/array); flags
+        index global steps ``first_gstep + j``. Returns True the moment
+        the escalation threshold trips — the REMAINING flags of the
+        span are discarded unprocessed: the trainer rolls back and
+        replays everything from the streak's first bad step, so a
+        trailing healthy flag belongs to an abandoned timeline and must
+        not reset ``streak_start`` (the rollback's upper bound)."""
+        for j, s in enumerate(np.asarray(skipped_stack).reshape(-1)):
+            if int(s):
+                if self.consecutive == 0:
+                    self.streak_start = first_gstep + j
+                self.consecutive += 1
+                self.skipped_steps += 1
+                if self.registry is not None:
+                    self.registry.counter(
+                        "train_skipped_steps_total",
+                        "optimizer updates skipped by the non-finite "
+                        "gradient guard",
+                    ).inc()
+                if self.tracer:
+                    self.tracer.event("guard_skip", gstep=first_gstep + j,
+                                      consecutive=self.consecutive)
+                if self.max_bad_steps \
+                        and self.consecutive >= self.max_bad_steps:
+                    return True
+            else:
+                self.consecutive = 0
+                self.streak_start = None
+        return False
+
+    def rolled_back(self, to_step: int) -> None:
+        """Record a completed rollback; raises once ``max_rollbacks`` is
+        exceeded (a persistent fault must fail loudly, not cycle)."""
+        self.rollbacks += 1
+        self.consecutive = 0
+        self.streak_start = None
+        if self.registry is not None:
+            self.registry.counter(
+                "train_rollbacks_total",
+                "rollbacks to the last good checkpoint after "
+                "max_bad_steps consecutive guarded skips",
+            ).inc()
+        if self.tracer:
+            self.tracer.event("guard_rollback", to_step=int(to_step),
+                              rollbacks=self.rollbacks)
+        if self.rollbacks > self.max_rollbacks:
+            raise RuntimeError(
+                f"guard escalation: {self.rollbacks} rollbacks exceed "
+                f"max_rollbacks={self.max_rollbacks} — the divergence "
+                "recurs after restoring the last good checkpoint "
+                "(persistent bad data or a real model divergence, not a "
+                "transient fault); inspect train_skipped_steps_total and "
+                "the guard_skip trace events"
+            )

@@ -38,6 +38,24 @@ whatever mix of strangers shares the batch, whenever it arrives, and
 whether the prefix cache or chunking is on or off (pinned by
 tests/test_serve.py against cache-off and isolated runs).
 
+Robustness (ISSUE 6): per-request **TTFT/total deadlines** (wall
+seconds from eligibility; per-request fields override scheduler
+defaults) — expiry EVICTS the request, freeing its slot and releasing
+any pinned prefix refs, and returns
+``Completion(status="deadline_exceeded")`` with the partial tokens; a
+queued request past its deadline is cancelled without ever admitting.
+**Admission shedding** (``shed_threshold``): a request whose first
+eligible tick finds outstanding work (occupied slots + waiting
+eligibles) at the threshold is refused with ``status="shed"`` — under
+overload the newest arrivals degrade instead of every admitted
+request's ITL. Both validated at construction (non-positive deadlines
+and thresholds below the slot count are config errors, not silent
+no-ops); both count into the registry (``serve_deadline_exceeded_total``,
+``serve_shed_total``) and trace as events. Eviction is host bookkeeping
+exactly like completion (masked cache rows are invisible), so
+co-resident requests' tokens are bit-identical with or without a
+neighbour being evicted (pinned in tests/test_resilience.py).
+
 Metrics: prefill tok/s, decode tok/s/slot, per-decode-step latency
 p50/p95/p99, TTFT (wall clock from arrival-eligibility to first
 token), ITL (gap between consecutive decode completions while slots
@@ -85,21 +103,36 @@ MIN_PREFIX_HIT = 2
 class Request:
     """One generation request. ``arrival`` is the earliest scheduler
     step at which it may be admitted — tests and benchmarks stagger
-    arrivals with it; a live frontend would enqueue with ``arrival=0``."""
+    arrivals with it; a live frontend would enqueue with ``arrival=0``.
+
+    Deadlines (ISSUE 6): ``ttft_deadline_s`` bounds eligibility → first
+    token, ``deadline_s`` eligibility → completion (both wall seconds;
+    None inherits the scheduler's defaults). Expiry EVICTS the request
+    — slot freed, pinned prefix refs released — and returns a
+    ``Completion(status="deadline_exceeded")`` with whatever tokens
+    were generated, instead of holding a slot forever."""
 
     id: int
     prompt: np.ndarray  # int32 [p], p >= 1
     max_new_tokens: int
     arrival: int = 0
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
 class Completion:
+    """``status`` is the structured outcome: ``"ok"`` (ran to its stop
+    condition), ``"deadline_exceeded"`` (evicted at a TTFT/total
+    deadline — ``tokens`` holds the partial output), or ``"shed"``
+    (refused at admission under overload; never occupied a slot)."""
+
     id: int
     prompt_len: int
     tokens: list[int]  # generated ids (includes the eos token if hit)
-    admitted_step: int
+    admitted_step: int  # -1: never admitted (shed / expired in queue)
     finished_step: int
+    status: str = "ok"
 
 
 @dataclasses.dataclass
@@ -157,10 +190,38 @@ class Scheduler:
 
     def __init__(self, engine: InferenceEngine, *, eos_id: int | None = None,
                  allow_window: bool = False, tracer=None, registry=None,
-                 metrics_writer=None):
+                 metrics_writer=None, ttft_deadline_s: float | None = None,
+                 deadline_s: float | None = None,
+                 shed_threshold: int | None = None, injector=None):
         self.engine = engine
         self.eos_id = eos_id
         self.allow_window = allow_window
+        # Resilience config (ISSUE 6), validated at CONSTRUCTION in
+        # _validate's submit-time style — a bad value is a loud error
+        # naming the offender, never a silently-never-firing deadline
+        # or a shed threshold that refuses servable traffic.
+        for name, v in (("ttft_deadline_s", ttft_deadline_s),
+                        ("deadline_s", deadline_s)):
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"{name} must be > 0 seconds, got {v} (a non-positive "
+                    "deadline would expire every request at its first "
+                    "tick)"
+                )
+        if shed_threshold is not None and shed_threshold < engine.config.slots:
+            raise ValueError(
+                f"shed_threshold ({shed_threshold}) is below the engine's "
+                f"concurrent capacity (slots={engine.config.slots}) — it "
+                "would shed traffic the batch could serve; use a value "
+                ">= slots"
+            )
+        self.ttft_deadline_s = ttft_deadline_s
+        self.deadline_s = deadline_s
+        self.shed_threshold = shed_threshold
+        # Deterministic fault injector (resilience.faults): `stalls(id)`
+        # defers that request's prefill forever — the hung-upstream
+        # model the deadline eviction path is pinned against.
+        self.injector = injector
         # Telemetry (module docstring): request-lifecycle tracer,
         # metric registry and (rate-limited) JSONL snapshot writer, all
         # optional and all suppressed during warmup. NULL_TRACER is
@@ -191,20 +252,29 @@ class Scheduler:
         # Compile traffic must not pollute the run's telemetry: the
         # clone run emits no lifecycle events and moves no counters
         # (the derived-TTFT pin would otherwise see the warmup's
-        # negative-id requests).
-        saved = self.tracer, self.registry, self.metrics_writer
+        # negative-id requests). Deadlines, shedding and fault
+        # injection are likewise suppressed — a warmup clone evicted or
+        # shed would skip compiling the programs the real run needs.
+        saved = (self.tracer, self.registry, self.metrics_writer,
+                 self.ttft_deadline_s, self.deadline_s,
+                 self.shed_threshold, self.injector)
         self.tracer, self.registry, self.metrics_writer = \
             NULL_TRACER, None, None
+        self.ttft_deadline_s = self.deadline_s = None
+        self.shed_threshold = self.injector = None
         try:
             self.run([
                 dataclasses.replace(
                     r, id=-1 - i,
                     max_new_tokens=min(2, r.max_new_tokens),
+                    ttft_deadline_s=None, deadline_s=None,
                 )
                 for i, r in enumerate(requests)
             ])
         finally:
-            self.tracer, self.registry, self.metrics_writer = saved
+            (self.tracer, self.registry, self.metrics_writer,
+             self.ttft_deadline_s, self.deadline_s,
+             self.shed_threshold, self.injector) = saved
         max_bucket = eng.prefill_bucket(max(
             int(np.asarray(r.prompt).shape[0]) for r in requests
         ))
@@ -258,6 +328,27 @@ class Scheduler:
                 f"(pass allow_window=True to accept sliding-window "
                 f"attention once the ring wraps)"
             )
+        for name, v in (("ttft_deadline_s", r.ttft_deadline_s),
+                        ("deadline_s", r.deadline_s)):
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"request {r.id}: {name} must be > 0 seconds, got {v}"
+                )
+        if self.injector is not None and self.injector.stalls(r.id) \
+                and self._deadline_for(r) == (None, None):
+            raise ValueError(
+                f"request {r.id}: stall fault injected but no TTFT/total "
+                "deadline applies — the run would never terminate; set a "
+                "per-request or scheduler-default deadline"
+            )
+
+    def _deadline_for(self, r: Request) -> tuple[float | None, float | None]:
+        """Effective ``(ttft, total)`` wall-second deadlines for a
+        request: per-request values win, scheduler defaults fill in."""
+        ttft = r.ttft_deadline_s if r.ttft_deadline_s is not None \
+            else self.ttft_deadline_s
+        total = r.deadline_s if r.deadline_s is not None else self.deadline_s
+        return ttft, total
 
     def run(self, requests) -> tuple[dict[int, Completion], ServeStats]:
         """Serve ``requests`` to completion. Admission order is (arrival,
@@ -337,8 +428,16 @@ class Scheduler:
         lookups = hits = saved = 0
         last_decode_done: float | None = None
         step = 0
+        inj = self.injector
+        # Deadline machinery only arms when some deadline can apply —
+        # a bare Scheduler pays none of its clock reads or sweeps.
+        deadlines_on = (
+            self.ttft_deadline_s is not None or self.deadline_s is not None
+            or any(r.ttft_deadline_s is not None or r.deadline_s is not None
+                   for r in requests)
+        )
 
-        def finish(s: int) -> None:
+        def finish(s: int, status: str = "ok") -> None:
             r = occupant[s]
             done[r.id] = Completion(
                 id=r.id,
@@ -346,18 +445,44 @@ class Scheduler:
                 tokens=list(generated[s]),
                 admitted_step=int(admitted_at[s]),
                 finished_step=step,
+                status=status,
             )
             active[s] = False
             occupant[s] = None
             if held_entry[s] >= 0:
+                # Deadline eviction releases pinned prefix refs exactly
+                # like normal completion — an evicted request can never
+                # wedge the pool.
                 eng.prefix_release(held_entry[s])
                 held_entry[s] = -1
             if tr:
                 # Completion IS the eviction: the slot frees here.
                 tr.event("complete", req=int(r.id), slot=s, step=step,
-                         tokens=len(generated[s]))
+                         tokens=len(generated[s]), status=status)
             if reg is not None:
-                reg.counter("serve_requests_completed_total").inc()
+                if status == "deadline_exceeded":
+                    reg.counter("serve_deadline_exceeded_total").inc()
+                else:
+                    reg.counter("serve_requests_completed_total").inc()
+
+        def expire_queued(r: Request, status: str) -> None:
+            """Remove a never-admitted request from the queue with a
+            structured outcome (shed at admission, or expired while
+            waiting) — it held no slot and pinned nothing."""
+            pending.remove(r)
+            done[r.id] = Completion(
+                id=r.id,
+                prompt_len=int(np.asarray(r.prompt).shape[0]),
+                tokens=[], admitted_step=-1, finished_step=step,
+                status=status,
+            )
+            if tr:
+                tr.event(status, req=int(r.id), step=step)
+            if reg is not None:
+                reg.counter(
+                    "serve_shed_total" if status == "shed"
+                    else "serve_deadline_exceeded_total"
+                ).inc()
 
         def finished(s: int, token: int) -> bool:
             return (len(generated[s]) >= occupant[s].max_new_tokens
@@ -368,15 +493,62 @@ class Scheduler:
             # (arrival reached), whether or not a slot is free — the
             # queueing delay is part of time-to-first-token.
             now = time.perf_counter()
+            # Admission shedding decides ONCE, at first eligibility:
+            # outstanding work (occupied slots + already-waiting
+            # eligibles) at or past the threshold refuses the newcomer
+            # with a structured "shed" — overload degrades the newest
+            # arrivals instead of collapsing every admitted request's
+            # ITL.
+            outstanding = -1
+            if self.shed_threshold is not None:
+                outstanding = sum(o is not None for o in occupant) + sum(
+                    1 for q in pending
+                    if q.arrival <= step and q.id in eligible_wall
+                )
+            shed_now = []
             for r in pending:
                 if r.arrival > step:
                     break  # pending is (arrival, id)-sorted
                 if r.id not in eligible_wall:
+                    if self.shed_threshold is not None \
+                            and outstanding >= self.shed_threshold:
+                        shed_now.append(r)
+                        continue
                     eligible_wall[r.id] = now
+                    outstanding += 1
                     if tr:
                         # Stamped with the SAME `now` the TTFT clock
                         # starts from — the derived-TTFT exactness pin.
                         tr.event("eligible", t=now, req=int(r.id), step=step)
+            for r in shed_now:
+                expire_queued(r, "shed")
+            if deadlines_on:
+                # Expiry sweep: waiting requests past any applicable
+                # deadline never admit; occupied slots past theirs evict
+                # (partial tokens kept, prefix pins released in finish).
+                expired = []
+                for r in pending:
+                    if r.arrival > step:
+                        break
+                    t0 = eligible_wall.get(r.id)
+                    if t0 is None:
+                        continue
+                    lims = [v for v in self._deadline_for(r) if v is not None]
+                    if lims and now - t0 > min(lims):
+                        expired.append(r)
+                for r in expired:
+                    expire_queued(r, "deadline_exceeded")
+                for s in range(S):
+                    r = occupant[s]
+                    if r is None:
+                        continue
+                    ttft, total = self._deadline_for(r)
+                    # Pre-first-token both deadlines bound the wait;
+                    # once decoding, only the total deadline applies.
+                    lims = [v for v in ((ttft, total) if not active[s]
+                                        else (total,)) if v is not None]
+                    if lims and now - eligible_wall[r.id] > min(lims):
+                        finish(s, status="deadline_exceeded")
             # Admit: claim every free slot whose turn has come. With the
             # prefix cache, admission itself is only the (optional) row
             # copy — prompt compute happens in the prefill phase below.
@@ -429,9 +601,16 @@ class Scheduler:
             # prompt at once when chunking is off, else chunk-at-a-time
             # under the shared per-tick token budget.
             budget = budget0
+            prefilled_any = False
             for s in range(S):
                 r = occupant[s]
                 if r is None or active[s]:
+                    continue
+                if inj is not None and inj.stalls(r.id):
+                    # Injected stall (resilience.faults): the prefill
+                    # never advances — the hung-upstream failure mode a
+                    # deadline must evict (validated at submit: a
+                    # stalled request always has one).
                     continue
                 prompt = np.asarray(r.prompt, np.int32)
                 p = int(prompt.shape[0])
@@ -459,6 +638,7 @@ class Scheduler:
                             prefill_timer._times[-1]
                         )
                     prefilled[s] += n
+                    prefilled_any = True
                     lengths[s] = prefilled[s]  # see admission comment
                     if budget0:
                         budget -= n
@@ -525,6 +705,12 @@ class Scheduler:
                 # No decoder advanced this tick: the next decode's gap
                 # is idle/prefill lead-in, not an inter-token stall.
                 last_decode_done = None
+                if deadlines_on and not prefilled_any \
+                        and any(o is not None for o in occupant):
+                    # Only stalled/expiring work remains — yield the
+                    # host briefly instead of spinning the tick loop
+                    # flat-out until a wall-clock deadline passes.
+                    time.sleep(0.0005)
             if reg is not None:
                 # Per-tick utilization gauges (sampled, last-write-wins
                 # in the registry; history lands in the JSONL snapshots).

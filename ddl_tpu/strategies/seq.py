@@ -265,6 +265,8 @@ class LMResult:
     step_stats: StepStats | None = None
     resumed_from_step: int = 0  # global batch restored from a checkpoint
     preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
+    skipped_steps: int = 0  # updates skipped by the non-finite guard
+    rollbacks: int = 0  # guard escalations to the last good checkpoint
 
 
 def _vary_axes(config: SeqConfig) -> tuple[str, ...]:
@@ -427,7 +429,8 @@ class _FlatPlan:
 
 
 def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
-                     platform: str | None = None, health: bool = False):
+                     platform: str | None = None, health: bool = False,
+                     guard: bool = False):
     """One ZeRO-1 train step inside ``shard_map`` (``check_vma=False``,
     like the CNN sharded path): grads here are LOCAL — each shard
     differentiates its own scored-token sum over the GLOBAL denominator
@@ -453,18 +456,32 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
             coll.pad_to(plan.flatten(params), chunk * n_dev),
             (my_chunk * chunk,), (chunk,),
         )
+        old_opt = opt
         p_new, opt = _adam_flat(p_own, opt, g_own, lr=config.learning_rate)
         full = lax.all_gather(p_new, AXES, tiled=True)[: plan.total]
         new_tree = plan.unflatten(full)
-        if not health:
-            return new_tree, opt, loss
-        # Grad stats from the flat chunks (disjoint over dp x sp — one
-        # psum is the global answer); param/update norms from the full
-        # trees both sides of the update, which zero1 keeps replicated.
-        sq, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
-        h = {"grad_norm": jnp.sqrt(sq), "nonfinite_grads": nf,
-             **hlt.norm_signals(params, new_tree, None)}
-        return new_tree, opt, loss, {k: h[k] for k in hlt.health_keys(params)}
+        out = ()
+        if guard:
+            # The non-finite count over the flat chunks (disjoint over
+            # dp x sp — one psum is the global, replicated answer), so
+            # every device selects the SAME branch.
+            from ..resilience.guard import apply_guard
+
+            _, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+            new_tree, opt, skipped = apply_guard(
+                nf, params, old_opt, new_tree, opt
+            )
+            out = (skipped,)
+        if health:
+            # Grad stats from the flat chunks (disjoint over dp x sp —
+            # one psum is the global answer); param/update norms from
+            # the full trees both sides of the APPLIED update, which
+            # zero1 keeps replicated.
+            sq, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+            h = {"grad_norm": jnp.sqrt(sq), "nonfinite_grads": nf,
+                 **hlt.norm_signals(params, new_tree, None)}
+            out = ({k: h[k] for k in hlt.health_keys(params)},) + out
+        return (new_tree, opt, loss) + out
 
     return step
 
@@ -544,7 +561,8 @@ class _HybridPlan:
 
 
 def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
-                        platform: str | None = None, health: bool = False):
+                        platform: str | None = None, health: bool = False,
+                        guard: bool = False):
     """One hybrid zero1 x tensor_parallel train step inside ``shard_map``
     (``check_vma=False``). Local grads come out of ``_local_loss_fn``
     dp/sp-partial and tp-complete (the f/g pair); then each subtree gets
@@ -597,31 +615,46 @@ def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
             p_tp, AdamState(step=opt.step, m=opt.m_tp, v=opt.v_tp), g_tp,
             lr=config.learning_rate,
         )
-        opt = HybridAdam(step=flat.step, m_flat=flat.m, v_flat=flat.v,
-                         m_tp=tp_state.m, v_tp=tp_state.v)
+        new_opt = HybridAdam(step=flat.step, m_flat=flat.m, v_flat=flat.v,
+                             m_tp=tp_state.m, v_tp=tp_state.v)
         new_tree = hplan.merge(rep_new, tp_new)
-        if not health:
-            return new_tree, opt, loss
-        # Replicated subtree: flat-chunk stats over (dp, sp). tp leaves:
-        # g_tp is already (dp, sp)-complete per shard, so their squared
-        # sums / non-finite counts reduce over tp only. Param/update
-        # norms take the trainer's spec tree, which names exactly that
-        # tp sharding.
-        sq, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
-        tp_sq = sum(
-            (jnp.sum(jnp.square(g.astype(jnp.float32))) for g in g_tp),
-            jnp.float32(0.0),
-        )
-        tp_nf = sum(
-            (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)))
-             .astype(jnp.int32) for g in g_tp),
-            jnp.int32(0),
-        )
-        sq = sq + lax.psum(tp_sq, TP_AXIS)
-        nf = nf + lax.psum(tp_nf, TP_AXIS)
-        h = {"grad_norm": jnp.sqrt(sq), "nonfinite_grads": nf,
-             **hlt.norm_signals(params, new_tree, _param_specs(config))}
-        return new_tree, opt, loss, {k: h[k] for k in hlt.health_keys(params)}
+
+        def global_nonfinite():
+            # Flat-chunk count over (dp, sp) + the tp leaves' count
+            # (g_tp is already (dp, sp)-complete per shard, so their
+            # non-finite counts reduce over tp only) — replicated.
+            _, nf = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+            tp_nf = sum(
+                (jnp.sum(~jnp.isfinite(g.astype(jnp.float32)))
+                 .astype(jnp.int32) for g in g_tp),
+                jnp.int32(0),
+            )
+            return nf + lax.psum(tp_nf, TP_AXIS)
+
+        out = ()
+        if guard:
+            from ..resilience.guard import apply_guard
+
+            new_tree, new_opt, skipped = apply_guard(
+                global_nonfinite(), params, opt, new_tree, new_opt
+            )
+            out = (skipped,)
+        if health:
+            # Replicated subtree: flat-chunk stats over (dp, sp). tp
+            # leaves reduce their squared sums over tp. Param/update
+            # norms take the trainer's spec tree, which names exactly
+            # that tp sharding; the update is the APPLIED one.
+            sq, _ = hlt.flat_grad_sq_nonfinite(g_own, AXES)
+            tp_sq = sum(
+                (jnp.sum(jnp.square(g.astype(jnp.float32))) for g in g_tp),
+                jnp.float32(0.0),
+            )
+            sq = sq + lax.psum(tp_sq, TP_AXIS)
+            h = {"grad_norm": jnp.sqrt(sq),
+                 "nonfinite_grads": global_nonfinite(),
+                 **hlt.norm_signals(params, new_tree, _param_specs(config))}
+            out = ({k: h[k] for k in hlt.health_keys(params)},) + out
+        return (new_tree, new_opt, loss) + out
 
     return step
 
@@ -653,7 +686,7 @@ def _local_loss_fn(config: SeqConfig, attn, tokens, targets, weights):
 
 
 def _step_body(config: SeqConfig, platform: str | None = None,
-               health: bool = False):
+               health: bool = False, guard: bool = False):
     """One train step, already inside ``shard_map`` (``check_vma=False``):
     local grads (see ``_local_loss_fn``), ONE explicit ``psum`` over the
     (dp, sp) axes — full gradients for replicated leaves, per-shard-full
@@ -677,12 +710,21 @@ def _step_body(config: SeqConfig, platform: str | None = None,
         new_params, new_opt = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
-        if not health:
-            return new_params, new_opt, loss
-        h = hlt.health_signals(
-            grads, params, new_params, _param_specs(config)
-        )
-        return new_params, new_opt, loss, h
+        out = ()
+        if guard:
+            from ..resilience.guard import apply_guard
+
+            new_params, new_opt, skipped = apply_guard(
+                hlt.nonfinite_count(grads, _param_specs(config)),
+                params, opt_state, new_params, new_opt,
+            )
+            out = (skipped,)
+        if health:
+            h = hlt.health_signals(
+                grads, params, new_params, _param_specs(config)
+            )
+            out = (h,) + out
+        return (new_params, new_opt, loss) + out
 
     return step
 
@@ -874,7 +916,8 @@ class SeqTrainer:
         inflate dp-fold so accuracies stay exact)."""
         return P(*([None] * (ndim - 1) + [SP_AXIS]))
 
-    def span_program(self, k: int, health: bool = False):
+    def span_program(self, k: int, health: bool = False,
+                     guard: bool = False):
         """``(params, opt, xs, ys, ws, first) -> (params, opt, loss)``:
         ``k`` consecutive batches as ONE device-resident program
         (``steps_scan`` span, same structure as ``trainer.make_epoch_chunk``).
@@ -885,10 +928,16 @@ class SeqTrainer:
         health signals (``obs.health``) as a fourth output — computed
         per step inside the scan, fetched by the caller in ONE batched
         device->host transfer, so the hot path never gains a per-step
-        sync. ``health=False`` builds the exact pre-observability
+        sync. ``guard=True`` (ISSUE 6) compiles the NaN-guarded step —
+        a non-finite gradient applies identity in-graph
+        (``resilience.guard``) — and appends the ``[k]``-stacked int32
+        skip flags as the LAST output. Both flags are Python branches:
+        ``health=False, guard=False`` builds the exact pre-change
         program."""
         seq = P(DP_AXIS, SP_AXIS)  # train batch [B, T]: B over dp, T over sp
         hspec = hlt.health_out_specs(self._host_like) if health else None
+        extra = (((hspec,) if health else ())
+                 + ((P(),) if guard else ()))  # skipped flag: replicated
         # EVERY step body runs check_vma=False (local-grads mode): each
         # body computes unreduced dp/sp gradients and applies its own
         # explicit reduction (psum / psum_scatter); a replication checker
@@ -902,7 +951,8 @@ class SeqTrainer:
             from ..pipeline.trainer import pipeline_shard_step
 
             shard_step = pipeline_shard_step(
-                self.config, self.mesh, self._platform, health=health
+                self.config, self.mesh, self._platform, health=health,
+                guard=guard,
             )
         elif self._hplan is not None:
             opt_spec = HybridAdam(
@@ -912,50 +962,51 @@ class SeqTrainer:
             )
             shard_step = jax.shard_map(
                 _zero1_tp_step_body(self.config, self._hplan,
-                                    self._platform, health=health),
+                                    self._platform, health=health,
+                                    guard=guard),
                 mesh=self.mesh,
                 in_specs=(self._pspecs, opt_spec, seq, seq, seq),
-                out_specs=(self._pspecs, opt_spec, P())
-                + ((hspec,) if health else ()),
+                out_specs=(self._pspecs, opt_spec, P()) + extra,
                 check_vma=False,
             )
         elif self.config.zero1:
             opt_spec = ShardedAdam(step=P(), m=P(AXES), v=P(AXES))
             shard_step = jax.shard_map(
                 _zero1_step_body(self.config, self._plan, self._platform,
-                                 health=health),
+                                 health=health, guard=guard),
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, seq, seq, seq),
-                out_specs=(P(), opt_spec, P())
-                + ((hspec,) if health else ()),
+                out_specs=(P(), opt_spec, P()) + extra,
                 check_vma=False,
             )
         else:
             shard_step = jax.shard_map(
-                _step_body(self.config, self._platform, health=health),
+                _step_body(self.config, self._platform, health=health,
+                           guard=guard),
                 mesh=self.mesh,
                 in_specs=(self._pspecs, self._opt_specs, seq, seq, seq),
-                out_specs=(self._pspecs, self._opt_specs, P())
-                + ((hspec,) if health else ()),
+                out_specs=(self._pspecs, self._opt_specs, P()) + extra,
                 check_vma=False,
             )
 
         def run(params, opt_state, xs, ys, ws, first):
             def body(carry, i):
                 p, o = carry
-                if health:
-                    p, o, l, h = shard_step(p, o, xs[i], ys[i], ws[i])
-                    return (p, o), (l, h)
-                p, o, l = shard_step(p, o, xs[i], ys[i], ws[i])
-                return (p, o), l
+                out = shard_step(p, o, xs[i], ys[i], ws[i])
+                return (out[0], out[1]), tuple(out[2:])
 
             (params, opt_state), out = steps_scan(
                 body, (params, opt_state), first + jnp.arange(k), k
             )
+            # out = (losses[, healths][, skipped]), each [k]-stacked;
+            # report the span's LAST loss, the stacked health dict and
+            # the full stacked skip flags.
+            res = (params, opt_state, out[0][-1])
             if health:
-                losses, healths = out
-                return params, opt_state, losses[-1], healths
-            return params, opt_state, out[-1]
+                res = res + (out[1],)
+            if guard:
+                res = res + (out[-1],)
+            return res
 
         # Donate params + optimizer state (halved peak HBM, like every
         # other trainer's step); donation_for gates off the multi-device
@@ -1174,7 +1225,7 @@ class SeqTrainer:
         *,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
-        resume: bool = False,
+        resume=False,
         profile_dir: str | None = None,
         should_stop=None,
         dispatch_timeout: float = 0.0,
@@ -1182,6 +1233,11 @@ class SeqTrainer:
         metrics_interval: int = 10,
         metrics_writer=None,
         tracer=None,
+        guard: bool = False,
+        max_bad_steps: int = 0,
+        max_rollbacks: int = 3,
+        fault_injector=None,
+        checkpoint_keep: int = 2,
     ) -> LMResult:
         """Same persistence/observability contract as every other trainer:
         atomic rolling checkpoint at epoch ends (plus every
@@ -1199,7 +1255,20 @@ class SeqTrainer:
         programs are byte-identical to the pre-observability ones).
         ``metrics_writer`` (an ``obs.MetricsWriter``) is flushed on its
         own interval from the span loop. ``tracer`` (``obs.Tracer``)
-        wraps every span dispatch and eval in host wall-clock spans."""
+        wraps every span dispatch and eval in host wall-clock spans.
+
+        Resilience (ISSUE 6): ``resume`` accepts ``"auto"`` (newest
+        VALID checkpoint — corrupt/truncated saves skipped by
+        ``find_latest_valid``); saves retain the last
+        ``checkpoint_keep`` step-stamped files. ``guard=True`` (implied
+        by ``max_bad_steps > 0``) compiles the NaN-guarded step in
+        EVERY mode (replicated / zero1 / hybrid / pipeline):
+        a non-finite gradient applies identity in-graph, and
+        ``max_bad_steps`` consecutive skips roll back to the last good
+        checkpoint and replay from its step — the data stream is
+        indexed by global step, so position IS the re-seed.
+        ``fault_injector`` (``resilience.faults``) is the deterministic
+        chaos hook the tests and ``--inject-fault`` drive."""
         cfg = self.config
         if tracer is None:
             tracer = NULL_TRACER
@@ -1209,9 +1278,28 @@ class SeqTrainer:
         # pre-flight lives there, so the CLI's ValueError guard can wrap
         # construction only — round-4 advisor).
         batch_num = ds.num_train // bs
+        inj = fault_injector
+        guard_on = bool(guard) or max_bad_steps > 0
+        monitor = None
+        if guard_on:
+            from ..resilience.guard import GuardMonitor
+
+            monitor = GuardMonitor(max_bad_steps,
+                                   max_rollbacks=max_rollbacks,
+                                   registry=metrics, tracer=tracer)
+
+        def _stage_ws():
+            # The grad-fault injection point: one poisoned loss weight
+            # drives that batch's loss — and so every gradient — non-
+            # finite through the REAL forward (no mock grads anywhere).
+            w = ds.weights
+            if inj is not None and inj.poisons_data():
+                w = inj.poison_batches(np.asarray(w), batch_num, bs)
+            return self.stage_batches(w, batch_num, bs)
+
         xs = self.stage_batches(ds.tokens, batch_num, bs)
         ys = self.stage_batches(ds.targets, batch_num, bs)
-        ws = self.stage_batches(ds.weights, batch_num, bs)
+        ws = _stage_ws()
         put_test = lambda a: multihost.put(
             self.mesh, self._seq_spec(2), self._permuted(a)
         )
@@ -1226,11 +1314,8 @@ class SeqTrainer:
         # Resume template in CHECKPOINT form: standard params-shaped
         # trees in every mode (a pipeline run's live params are stacked,
         # but its checkpoints — like everyone else's — are not).
-        tree, start_step = try_resume(
-            ckpt, resume,
-            {"params": dict(self._host_like), "opt": self._opt_like()},
-            log,
-        )
+        like = {"params": dict(self._host_like), "opt": self._opt_like()}
+        tree, start_step = try_resume(ckpt, resume, like, log)
         if tree is not None:
             params = self._place_params(tree["params"])
             opt_state = self._place_opt(tree["opt"])
@@ -1247,15 +1332,46 @@ class SeqTrainer:
             start_step, batch_num, cfg.eval_every, spans
         )
         health_on = metrics is not None
+        fns: dict[int, Any] = {}
+        compile_time = 0.0
+
+        def fn_for(k: int):
+            # On-demand: a guard rollback can realign spans onto
+            # lengths the initial plan never compiled.
+            nonlocal compile_time
+            if k not in fns:
+                tc = time.perf_counter()
+                fns[k] = (
+                    self.span_program(k, health=health_on, guard=guard_on)
+                    .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
+                    .compile()
+                )
+                compile_time += time.perf_counter() - tc
+            return fns[k]
+
         t0 = time.perf_counter()
-        fns = {
-            k: self.span_program(k, health=health_on)
-            .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
-            .compile()
-            for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}
-        }
+        for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}:
+            fn_for(k)
         ev = self._eval_fn().lower(params, xte, yte, wte).compile()
         compile_time = time.perf_counter() - t0
+
+        def _rollback():
+            """Guard escalation: restore the newest VALID checkpoint at
+            or before the divergence streak's first bad step (pruning
+            the abandoned newer saves — resilience.guard.rollback_state
+            owns the shared bookkeeping), heal a transient injected
+            fault (restaging clean weights), and return the step to
+            re-enter the span loop at."""
+            nonlocal params, opt_state, ws
+            from ..resilience.guard import rollback_state
+
+            rtree, rstep = rollback_state(checkpoint_dir, monitor, like, log)
+            params = self._place_params(rtree["params"])
+            opt_state = self._place_opt(rtree["opt"])
+            if inj is not None and inj.heal():
+                ws = _stage_ws()
+            force((ws, params, opt_state), all_leaves=True)
+            return rstep
 
         timer = StepTimer()
         history: list[tuple[int, int, float]] = []
@@ -1265,96 +1381,116 @@ class SeqTrainer:
         hit = preempted = False
         epoch = 0  # epochs=0: eval-only run (the loop never binds it)
         span_idx = 0
+        resumed_from = start_step
         start = time.perf_counter()
         with trace(profile_dir):
-            for epoch in range(cfg.epochs):
-                for first, k, eval_after in (
-                    resume_spans if epoch == resume_epoch else spans
-                ):
-                    gstep = epoch * batch_num + first
-                    if gstep < start_step:
-                        continue  # already done by the resumed run
-                    span_idx += 1
-                    with timer.step(images=k * tokens_per_batch), \
-                            tracer.span("train/span", gstep=gstep, k=k):
-                        out = fns[k](
-                            params, opt_state, xs, ys, ws, jnp.int32(first)
-                        )
-                        if health_on:
-                            params, opt_state, l, hstack = out
-                        else:
-                            params, opt_state, l = out
-                        # barrier: host fetch of the span loss (the whole
-                        # span chain executes to produce it)
-                        loss = guarded(
-                            lambda: float(l), dispatch_timeout,
-                            f"span dispatch at global batch {gstep}",
-                        )
-                    if metrics is not None:
-                        span_s = timer._times[-1]  # the bracket just closed
-                        metrics.gauge("train_loss").set(loss)
-                        metrics.gauge("train_step").set(gstep + k)
-                        metrics.histogram(
-                            "train_span_seconds",
-                            "wall seconds per dispatched span program",
-                        ).observe(span_s)
-                        metrics.gauge("train_tokens_per_sec").set(
-                            k * tokens_per_batch / span_s if span_s else 0.0
-                        )
-                        # The divergence tripwire reads EVERY span (a
-                        # [k] int32 fetch riding the loss barrier — the
-                        # span already executed, this adds no sync); the
-                        # full norm dict is fetched batched only on
-                        # spans crossing the metrics interval
-                        # (save_crossed reused as the crossing
-                        # predicate).
-                        hlt.record_nonfinite(
-                            metrics,
-                            jax.device_get(hstack["nonfinite_grads"]),
-                        )
-                        if save_crossed(gstep, k, metrics_interval,
-                                        first + k == batch_num):
-                            hlt.record_health(
-                                metrics, jax.device_get(hstack),
-                                include_nonfinite=False,
+            while True:
+                rolled = False
+                resume_epoch, resume_spans = resume_plan(
+                    start_step, batch_num, cfg.eval_every, spans
+                )
+                for epoch in range(cfg.epochs):
+                    for first, k, eval_after in (
+                        resume_spans if epoch == resume_epoch else spans
+                    ):
+                        gstep = epoch * batch_num + first
+                        if gstep < start_step:
+                            continue  # already done by the resumed run
+                        span_idx += 1
+                        with timer.step(images=k * tokens_per_batch), \
+                                tracer.span("train/span", gstep=gstep, k=k):
+                            out = fn_for(k)(
+                                params, opt_state, xs, ys, ws, jnp.int32(first)
                             )
-                        if metrics_writer is not None:
-                            metrics_writer.maybe_flush()
-                    if eval_after:
-                        with tracer.span("train/eval", gstep=gstep + k):
-                            accuracy = guarded(
-                                lambda: float(ev(params, xte, yte, wte)),
-                                dispatch_timeout,
-                                f"eval after batch {first + k - 1}",
+                            params, opt_state, l = out[0], out[1], out[2]
+                            hstack = out[3] if health_on else None
+                            skipped = out[-1] if guard_on else None
+                            # barrier: host fetch of the span loss (the whole
+                            # span chain executes to produce it)
+                            loss = guarded(
+                                lambda: float(l), dispatch_timeout,
+                                f"span dispatch at global batch {gstep}",
                             )
                         if metrics is not None:
-                            metrics.gauge("train_eval_accuracy").set(accuracy)
-                        history.append((epoch, first + k - 1, accuracy))
-                        log(
-                            f"epoch {epoch} batch {first + k - 1} "
-                            f"loss {loss:.4f} test_accuracy {accuracy:.4f}"
+                            span_s = timer._times[-1]  # the bracket just closed
+                            metrics.gauge("train_loss").set(loss)
+                            metrics.gauge("train_step").set(gstep + k)
+                            metrics.histogram(
+                                "train_span_seconds",
+                                "wall seconds per dispatched span program",
+                            ).observe(span_s)
+                            metrics.gauge("train_tokens_per_sec").set(
+                                k * tokens_per_batch / span_s if span_s else 0.0
+                            )
+                            # The divergence tripwire reads EVERY span (a
+                            # [k] int32 fetch riding the loss barrier — the
+                            # span already executed, this adds no sync); the
+                            # full norm dict is fetched batched only on
+                            # spans crossing the metrics interval
+                            # (save_crossed reused as the crossing
+                            # predicate). Recorded BEFORE the guard can
+                            # break to rollback, so even a tripping
+                            # span's non-finite burst lands in the
+                            # counter (the incident must be auditable).
+                            hlt.record_nonfinite(
+                                metrics,
+                                jax.device_get(hstack["nonfinite_grads"]),
+                            )
+                            if save_crossed(gstep, k, metrics_interval,
+                                            first + k == batch_num):
+                                hlt.record_health(
+                                    metrics, jax.device_get(hstack),
+                                    include_nonfinite=False,
+                                )
+                            if metrics_writer is not None:
+                                metrics_writer.maybe_flush()
+                        if guard_on and monitor.observe(
+                            jax.device_get(skipped), gstep
+                        ):
+                            start_step = _rollback()
+                            monitor.rolled_back(start_step)
+                            rolled = True
+                            break
+                        if eval_after:
+                            with tracer.span("train/eval", gstep=gstep + k):
+                                accuracy = guarded(
+                                    lambda: float(ev(params, xte, yte, wte)),
+                                    dispatch_timeout,
+                                    f"eval after batch {first + k - 1}",
+                                )
+                            if metrics is not None:
+                                metrics.gauge("train_eval_accuracy").set(accuracy)
+                            history.append((epoch, first + k - 1, accuracy))
+                            log(
+                                f"epoch {epoch} batch {first + k - 1} "
+                                f"loss {loss:.4f} test_accuracy {accuracy:.4f}"
+                            )
+                            # hit_target duck-types on .target_accuracy, which
+                            # SeqConfig shares with TrainConfig.
+                            hit = hit_target(cfg, accuracy)
+                        if inj is not None:
+                            inj.maybe_sigterm(gstep + k)
+                        preempted = preempted or check_preempt(
+                            should_stop, log, ckpt is not None, span_idx
                         )
-                        # hit_target duck-types on .target_accuracy, which
-                        # SeqConfig shares with TrainConfig.
-                        hit = hit_target(cfg, accuracy)
-                    preempted = preempted or check_preempt(
-                        should_stop, log, ckpt is not None, span_idx
-                    )
-                    if ckpt and save_crossed(
-                        gstep, k, checkpoint_every,
-                        first + k == batch_num or hit or preempted,
-                    ):
-                        save_checkpoint(
-                            ckpt,
-                            {"params": self._params_for_save(params),
-                             "opt": self._opt_for_save(opt_state)},
-                            step=gstep + k, extra={"epoch": epoch},
-                        )
-                    if hit or preempted:
+                        if ckpt and save_crossed(
+                            gstep, k, checkpoint_every,
+                            first + k == batch_num or hit or preempted,
+                        ):
+                            save_checkpoint(
+                                ckpt,
+                                {"params": self._params_for_save(params),
+                                 "opt": self._opt_for_save(opt_state)},
+                                step=gstep + k, extra={"epoch": epoch},
+                                keep=checkpoint_keep,
+                            )
+                        if hit or preempted:
+                            break
+                    if hit:
+                        log(f"target accuracy {cfg.target_accuracy} reached")
+                    if rolled or hit or preempted:
                         break
-                if hit:
-                    log(f"target accuracy {cfg.target_accuracy} reached")
-                if hit or preempted:
+                if not rolled:
                     break
         wall = time.perf_counter() - start
 
@@ -1383,6 +1519,8 @@ class SeqTrainer:
             tokens_per_sec=stats.tokens_per_sec,
             compile_time_s=compile_time,
             step_stats=stats,
-            resumed_from_step=start_step,
+            resumed_from_step=resumed_from,
             preempted=preempted,
+            skipped_steps=monitor.skipped_steps if monitor else 0,
+            rollbacks=monitor.rollbacks if monitor else 0,
         )

@@ -44,6 +44,8 @@ class TrainResult:
     step_stats: StepStats | None = None  # per-span dispatch-time percentiles
     resumed_from_step: int = 0  # global step restored from a checkpoint (0 = fresh)
     preempted: bool = False  # stopped early by should_stop (e.g. SIGTERM)
+    skipped_steps: int = 0  # updates skipped by the non-finite guard
+    rollbacks: int = 0  # guard escalations to the last good checkpoint
     # Async only: per-eval-point accuracies of every worker's STALE replica
     # — (epoch, round, [acc_w0..acc_wW-1]) — the reference's W per-worker
     # accuracy streams (each async worker evals its own replica,
@@ -54,13 +56,18 @@ class TrainResult:
 def make_train_step(
     config: TrainConfig,
     health: bool = False,
+    guard: bool = False,
 ) -> Callable[[dict, AdamState, jax.Array, jax.Array, jax.Array], tuple[dict, AdamState, jax.Array]]:
     """Build the jittable single-chip train step:
     ``(params, opt_state, x, y_onehot, rng) -> (params', opt_state', loss)``.
     ``health=True`` appends the in-graph health dict (``obs.health`` —
     grad norm, per-variable param/update norms, non-finite count) as a
-    fourth output; the flag is a Python-level branch, so the default
-    program is byte-identical to the pre-observability one."""
+    fourth output. ``guard=True`` (ISSUE 6) applies IDENTITY instead of
+    the Adam update whenever the gradients contain a non-finite element
+    (``resilience.guard.apply_guard`` — an in-graph select, no host
+    sync) and appends the step's int32 skip flag as the LAST output.
+    Both flags are Python-level branches, so the default program is
+    byte-identical to the pre-observability/pre-guard one."""
     compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
 
     def step(params, opt_state, x, y, rng):
@@ -76,12 +83,24 @@ def make_train_step(
         new_params, new_opt = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
-        if not health:
-            return new_params, new_opt, loss
-        from ..obs import health as hlt
+        out = ()
+        if guard:
+            from ..obs import health as hlt
+            from ..resilience.guard import apply_guard
 
-        h = hlt.health_signals(grads, params, new_params, None)
-        return new_params, new_opt, loss, h
+            new_params, new_opt, skipped = apply_guard(
+                hlt.nonfinite_count(grads, None),
+                params, opt_state, new_params, new_opt,
+            )
+            out = (skipped,)
+        if health:
+            from ..obs import health as hlt
+
+            # Health describes the APPLIED update: a guarded skip
+            # reports update_norm == 0 (and the tripwire count fires).
+            h = hlt.health_signals(grads, params, new_params, None)
+            out = (h,) + out
+        return (new_params, new_opt, loss) + out
 
     return step
 
@@ -240,7 +259,7 @@ def resume_plan(
 
 
 def make_epoch_chunk(
-    config: TrainConfig, k: int, health: bool = False
+    config: TrainConfig, k: int, health: bool = False, guard: bool = False
 ) -> Callable:
     """The single-chip device-resident multi-step program, shared by
     ``SingleChipTrainer`` and ``bench.py`` (so the benchmark measures the
@@ -253,10 +272,12 @@ def make_epoch_chunk(
     ``goff`` the global step offset feeding the dropout stream (identical
     stream to a per-step loop, so span chunking never changes numerics).
 
-    ``health=True`` appends the ``[k]``-stacked in-graph health dict as
-    a fourth output (fetched batched by the trainer — obs.health).
+    ``health=True`` appends the ``[k]``-stacked in-graph health dict
+    (fetched batched by the trainer — obs.health); ``guard=True``
+    appends the ``[k]``-stacked int32 skip flags as the LAST output
+    (``make_train_step`` guard semantics).
     """
-    step = make_train_step(config, health=health)
+    step = make_train_step(config, health=health, guard=guard)
 
     def chunk(params, opt_state, xs, ys, first, goff, rng_base):
         def body(carry, i):
@@ -264,19 +285,14 @@ def make_epoch_chunk(
             x = jax.lax.dynamic_index_in_dim(xs, first + i, 0, keepdims=False)
             y = jax.lax.dynamic_index_in_dim(ys, first + i, 0, keepdims=False)
             rng = jax.random.fold_in(rng_base, goff + i)
-            if health:
-                params, opt_state, loss, h = step(params, opt_state, x, y, rng)
-                return (params, opt_state), (loss, h)
-            params, opt_state, loss = step(params, opt_state, x, y, rng)
-            return (params, opt_state), loss
+            out = step(params, opt_state, x, y, rng)
+            return (out[0], out[1]), out[2:]
 
         (params, opt_state), out = steps_scan(
             body, (params, opt_state), jnp.arange(k), k
         )
-        if health:
-            losses, healths = out
-            return params, opt_state, losses.mean(), healths
-        return params, opt_state, out.mean()
+        # out = (losses[, healths][, skipped]) stacked over the span.
+        return (params, opt_state, out[0].mean()) + tuple(out[1:])
 
     return jax.jit(chunk, donate_argnums=(0, 1))
 
@@ -306,12 +322,19 @@ def checkpoint_file(checkpoint_dir: str | os.PathLike | None) -> str | None:
 
 def try_resume(
     ckpt_path: str | None,
-    resume: bool,
+    resume,
     like,
     log: Callable[[str], None],
 ):
     """Load the rolling checkpoint if resuming. Returns ``(tree|None, step)``
     where ``step`` is the global step count already completed (0 = fresh).
+
+    ``resume`` is falsy (fresh run), truthy (load ``ckpt_path``
+    exactly), or the string ``"auto"`` (ISSUE 6): discover the newest
+    VALID checkpoint in the directory via
+    ``utils.checkpoint.find_latest_valid`` — corrupt or truncated saves
+    are verified out (and logged), so a torn latest file resumes from
+    the previous retained one instead of crashing.
 
     A missing file starts fresh (first run of a to-be-resumed job); the
     caller re-places arrays onto its shardings. The reference cannot resume
@@ -321,7 +344,18 @@ def try_resume(
         return None, 0
     if ckpt_path is None:
         raise ValueError("resume requires a checkpoint directory")
-    if not os.path.exists(ckpt_path):
+    if resume == "auto":
+        from ..utils.checkpoint import find_latest_valid
+
+        found = find_latest_valid(
+            os.path.dirname(ckpt_path) or ".", log=log
+        )
+        if found is None:
+            log(f"[resume] no valid checkpoint near {ckpt_path}; "
+                "starting fresh")
+            return None, 0
+        ckpt_path = found[0]
+    elif not os.path.exists(ckpt_path):
         log(f"[resume] no checkpoint at {ckpt_path}; starting fresh")
         return None, 0
     try:
@@ -476,17 +510,19 @@ class SingleChipTrainer:
             else cnn.init_params(self.init_key, specs=config.model_specs())
         )
         self.opt_state = adam_init(self.params)
-        self._chunks: dict[tuple[int, bool], Callable] = {}
+        self._chunks: dict[tuple[int, bool, bool], Callable] = {}
 
-    def _chunk_fn(self, k: int, health: bool = False) -> Callable:
+    def _chunk_fn(self, k: int, health: bool = False,
+                  guard: bool = False) -> Callable:
         """Cached :func:`make_epoch_chunk` program for span length ``k``
-        (one cache entry per (k, health) — the health variant is a
-        different program)."""
-        if (k, health) not in self._chunks:
-            self._chunks[(k, health)] = make_epoch_chunk(
-                self.config, k, health=health
+        (one cache entry per (k, health, guard) — each flag combination
+        is a different program)."""
+        key = (k, health, guard)
+        if key not in self._chunks:
+            self._chunks[key] = make_epoch_chunk(
+                self.config, k, health=health, guard=guard
             )
-        return self._chunks[(k, health)]
+        return self._chunks[key]
 
     def train(
         self,
@@ -494,7 +530,7 @@ class SingleChipTrainer:
         *,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
-        resume: bool = False,
+        resume=False,
         profile_dir: str | None = None,
         should_stop: Callable[[], bool] | None = None,
         dispatch_timeout: float = 0.0,
@@ -502,19 +538,44 @@ class SingleChipTrainer:
         metrics_interval: int = 10,
         metrics_writer=None,
         tracer=None,
+        guard: bool = False,
+        max_bad_steps: int = 0,
+        max_rollbacks: int = 3,
+        fault_injector=None,
+        checkpoint_keep: int = 2,
     ) -> TrainResult:
         """``metrics``/``metrics_interval``/``metrics_writer``/``tracer``
         are the ISSUE-5 telemetry hooks (``obs``): with a registry the
         span programs compute in-graph health and the trainer fetches it
         batched on spans crossing ``metrics_interval`` steps; with
         ``metrics=None`` the compiled programs are byte-identical to the
-        pre-observability ones (no added sync — the acceptance bar)."""
+        pre-observability ones (no added sync — the acceptance bar).
+
+        Resilience (ISSUE 6): ``resume`` accepts ``"auto"`` (newest
+        VALID checkpoint in the directory — corrupt saves skipped);
+        saves retain the last ``checkpoint_keep`` step-stamped files.
+        ``guard=True`` (implied by ``max_bad_steps > 0``) compiles the
+        NaN-guarded step — a non-finite gradient applies identity
+        in-graph — and ``max_bad_steps`` consecutive skips roll back to
+        the last good checkpoint (requires a checkpoint dir) and replay
+        from there (the data stream is re-seeded by step position),
+        bounded by ``max_rollbacks``. ``fault_injector`` is the
+        deterministic chaos hook (``resilience.faults``)."""
         cfg = self.config
         if tracer is None:
             from ..obs.trace import NULL_TRACER
 
             tracer = NULL_TRACER
         health_on = metrics is not None
+        guard_on = bool(guard) or max_bad_steps > 0
+        inj = fault_injector
+        monitor = None
+        if guard_on:
+            from ..resilience.guard import GuardMonitor
+
+            monitor = GuardMonitor(max_bad_steps,
+                                   max_rollbacks=max_rollbacks,
+                                   registry=metrics, tracer=tracer)
         batch_num = self.dataset.num_train // cfg.batch_size
         n = batch_num * cfg.batch_size
         # Sequential batching, no shuffle — reference semantics
@@ -523,10 +584,20 @@ class SingleChipTrainer:
         # empty arrays instead of failing reshape inference — the old
         # per-batch loop ran zero steps in that case, and so does this.
         x_np = np.asarray(self.dataset.x_train)
-        xs = jnp.asarray(
-            x_np[:n].reshape(batch_num, cfg.batch_size, x_np.shape[-1]),
-            dtype=staging_dtype(cfg),
-        )
+
+        def _stage_xs():
+            # The grad-fault injection point: a poisoned image pixel
+            # drives the loss (and so every gradient) non-finite through
+            # the REAL forward — no mock grads anywhere.
+            arr = x_np
+            if inj is not None and inj.poisons_data():
+                arr = inj.poison_batches(arr, batch_num, cfg.batch_size)
+            return jnp.asarray(
+                arr[:n].reshape(batch_num, cfg.batch_size, arr.shape[-1]),
+                dtype=staging_dtype(cfg),
+            )
+
+        xs = _stage_xs()
         ys = jnp.asarray(
             self.y_train_onehot[:n].reshape(
                 batch_num, cfg.batch_size, self.y_train_onehot.shape[-1]
@@ -540,9 +611,8 @@ class SingleChipTrainer:
         params = jax.tree.map(jnp.copy, self.params)
         opt_state = jax.tree.map(jnp.copy, self.opt_state)
         ckpt = checkpoint_file(checkpoint_dir)
-        tree, start_step = try_resume(
-            ckpt, resume, {"params": params, "opt": opt_state}, log
-        )
+        like = {"params": params, "opt": opt_state}
+        tree, start_step = try_resume(ckpt, resume, like, log)
         if tree is not None:
             params = jax.tree.map(jnp.asarray, tree["params"])
             opt_state = jax.tree.map(jnp.asarray, tree["opt"])
@@ -553,108 +623,160 @@ class SingleChipTrainer:
                 dispatch_timeout, "train-set staging")
         history: list[tuple[int, int, float]] = []
         spans = eval_spans(batch_num, cfg.eval_every)
-        resume_epoch, resume_spans = resume_plan(
-            start_step, batch_num, cfg.eval_every, spans
-        )
         # AOT-compile every span program outside the timed region (first TPU
         # compile is tens of seconds; steady-state throughput must not absorb
         # it). ``lower().compile()`` does not execute anything.
-        t0 = time.perf_counter()
         args0 = (jnp.int32(0), jnp.int32(0), self.dropout_key)
-        fns = {
-            k: self._chunk_fn(k, health=health_on)
-            .lower(params, opt_state, xs, ys, *args0).compile()
-            for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}
-        }
+        fns: dict[int, Callable] = {}
+        compile_time = 0.0
+
+        def fn_for(k: int):
+            # On-demand: a guard rollback can realign spans onto lengths
+            # the initial plan never compiled.
+            nonlocal compile_time
+            if k not in fns:
+                tc = time.perf_counter()
+                fns[k] = self._chunk_fn(k, health=health_on, guard=guard_on) \
+                    .lower(params, opt_state, xs, ys, *args0).compile()
+                compile_time += time.perf_counter() - tc
+            return fns[k]
+
+        resume_epoch, resume_spans = resume_plan(
+            start_step, batch_num, cfg.eval_every, spans
+        )
+        for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}:
+            fn_for(k)
         # Warm the eval program too: its first call otherwise compiles
         # INSIDE the dispatch watchdog, which a steady-state-sized
         # --dispatch-timeout would misread as accelerator death.
+        t0 = time.perf_counter()
         if x_test.shape[0]:
             evaluate(params, x_test, y_test)
-        compile_time = time.perf_counter() - t0
+        compile_time += time.perf_counter() - t0
+        resumed_from = start_step
+
+        def _rollback():
+            """Guard escalation: restore the newest VALID checkpoint at
+            or before the divergence streak's first bad step (pruning
+            the abandoned newer saves — resilience.guard.rollback_state
+            owns the shared bookkeeping), heal a transient injected
+            fault (restaging clean data), and hand back the step to
+            re-enter the span loop at — which re-seeds the
+            deterministic data stream to exactly that step."""
+            nonlocal params, opt_state, xs
+            from ..resilience.guard import rollback_state
+
+            rtree, rstep = rollback_state(checkpoint_dir, monitor, like, log)
+            params = jax.tree.map(jnp.asarray, rtree["params"])
+            opt_state = jax.tree.map(jnp.asarray, rtree["opt"])
+            if inj is not None and inj.heal():
+                xs = _stage_xs()
+            force((xs, params, opt_state), all_leaves=True)
+            return rstep
+
         timer = StepTimer()
         stopped = preempted = False
         span_idx = 0
         start = time.perf_counter()
         with trace(profile_dir):
-            for epoch in range(cfg.epochs):
-                for first, k, eval_after in (
-                    resume_spans if epoch == resume_epoch else spans
-                ):
-                    gstep = epoch * batch_num + first
-                    if gstep < start_step:
-                        continue  # already done by the resumed run
-                    span_idx += 1
-                    with timer.step(images=k * cfg.batch_size), \
-                            tracer.span("train/span", gstep=gstep, k=k):
-                        out = fns[k](
-                            params, opt_state, xs, ys,
-                            jnp.int32(first), jnp.int32(gstep),
-                            self.dropout_key,
-                        )
-                        if health_on:
-                            params, opt_state, _, hstack = out
-                        else:
-                            params, opt_state, _ = out
-                        # barrier: the fns[k] span dispatch
-                        force_within(
-                            params, dispatch_timeout,
-                            f"span dispatch at global step {gstep}",
-                        )
-                    if metrics is not None:
-                        from ..obs import health as hlt
-
-                        span_s = timer._times[-1]  # the bracket just closed
-                        metrics.gauge("train_step").set(gstep + k)
-                        metrics.histogram(
-                            "train_span_seconds",
-                            "wall seconds per dispatched span program",
-                        ).observe(span_s)
-                        metrics.gauge("train_images_per_sec").set(
-                            k * cfg.batch_size / span_s if span_s else 0.0
-                        )
-                        # Tripwire from EVERY span (tiny [k] int32 fetch
-                        # after the span barrier); full norm dict only on
-                        # interval-crossing spans (batched fetch).
-                        hlt.record_nonfinite(
-                            metrics,
-                            jax.device_get(hstack["nonfinite_grads"]),
-                        )
-                        if save_crossed(gstep, k, metrics_interval,
-                                        first + k == batch_num):
-                            hlt.record_health(metrics,
-                                              jax.device_get(hstack),
-                                              include_nonfinite=False)
-                        if metrics_writer is not None:
-                            metrics_writer.maybe_flush()
-                    if eval_after:
-                        cnt = first + k - 1
-                        with tracer.span("train/eval", gstep=gstep + k):
-                            acc = guarded(
-                                lambda: evaluate(params, x_test, y_test),
-                                dispatch_timeout, f"eval after batch {cnt}",
+            while True:
+                rolled = False
+                resume_epoch, resume_spans = resume_plan(
+                    start_step, batch_num, cfg.eval_every, spans
+                )
+                for epoch in range(cfg.epochs):
+                    for first, k, eval_after in (
+                        resume_spans if epoch == resume_epoch else spans
+                    ):
+                        gstep = epoch * batch_num + first
+                        if gstep < start_step:
+                            continue  # already done by the resumed run
+                        span_idx += 1
+                        with timer.step(images=k * cfg.batch_size), \
+                                tracer.span("train/span", gstep=gstep, k=k):
+                            out = fn_for(k)(
+                                params, opt_state, xs, ys,
+                                jnp.int32(first), jnp.int32(gstep),
+                                self.dropout_key,
+                            )
+                            params, opt_state = out[0], out[1]
+                            hstack = out[3] if health_on else None
+                            skipped = out[-1] if guard_on else None
+                            # barrier: the fn_for(k) span dispatch
+                            force_within(
+                                params, dispatch_timeout,
+                                f"span dispatch at global step {gstep}",
                             )
                         if metrics is not None:
-                            metrics.gauge("train_eval_accuracy").set(acc)
-                        history.append((epoch, cnt, acc))
-                        log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
-                        stopped = hit_target(cfg, acc)
-                    preempted = preempted or check_preempt(
-                        should_stop, log, ckpt is not None, span_idx
-                    )
-                    if ckpt and save_crossed(
-                        gstep, k, checkpoint_every,
-                        first + k == batch_num or stopped or preempted,
-                    ):
-                        save_checkpoint(
-                            ckpt, {"params": params, "opt": opt_state},
-                            step=gstep + k, extra={"epoch": epoch},
+                            from ..obs import health as hlt
+
+                            span_s = timer._times[-1]  # bracket just closed
+                            metrics.gauge("train_step").set(gstep + k)
+                            metrics.histogram(
+                                "train_span_seconds",
+                                "wall seconds per dispatched span program",
+                            ).observe(span_s)
+                            metrics.gauge("train_images_per_sec").set(
+                                k * cfg.batch_size / span_s if span_s else 0.0
+                            )
+                            # Tripwire from EVERY span (tiny [k] int32
+                            # fetch after the span barrier); full norm
+                            # dict only on interval-crossing spans.
+                            # Recorded BEFORE the guard can break to
+                            # rollback, so even a tripping span's
+                            # non-finite burst lands in the counter.
+                            hlt.record_nonfinite(
+                                metrics,
+                                jax.device_get(hstack["nonfinite_grads"]),
+                            )
+                            if save_crossed(gstep, k, metrics_interval,
+                                            first + k == batch_num):
+                                hlt.record_health(metrics,
+                                                  jax.device_get(hstack),
+                                                  include_nonfinite=False)
+                            if metrics_writer is not None:
+                                metrics_writer.maybe_flush()
+                        if guard_on and monitor.observe(
+                            jax.device_get(skipped), gstep
+                        ):
+                            start_step = _rollback()
+                            monitor.rolled_back(start_step)
+                            rolled = True
+                            break
+                        if eval_after:
+                            cnt = first + k - 1
+                            with tracer.span("train/eval", gstep=gstep + k):
+                                acc = guarded(
+                                    lambda: evaluate(params, x_test, y_test),
+                                    dispatch_timeout,
+                                    f"eval after batch {cnt}",
+                                )
+                            if metrics is not None:
+                                metrics.gauge("train_eval_accuracy").set(acc)
+                            history.append((epoch, cnt, acc))
+                            log(f"epoch: {epoch} batch: {cnt} accuracy: {acc}")
+                            stopped = hit_target(cfg, acc)
+                        if inj is not None:
+                            inj.maybe_sigterm(gstep + k)
+                        preempted = preempted or check_preempt(
+                            should_stop, log, ckpt is not None, span_idx
                         )
-                    if stopped or preempted:
+                        if ckpt and save_crossed(
+                            gstep, k, checkpoint_every,
+                            first + k == batch_num or stopped or preempted,
+                        ):
+                            save_checkpoint(
+                                ckpt, {"params": params, "opt": opt_state},
+                                step=gstep + k, extra={"epoch": epoch},
+                                keep=checkpoint_keep,
+                            )
+                        if stopped or preempted:
+                            break
+                    if stopped:
+                        log(f"target accuracy {cfg.target_accuracy} reached")
+                    if rolled or stopped or preempted:
                         break
-                if stopped:
-                    log(f"target accuracy {cfg.target_accuracy} reached")
-                if stopped or preempted:
+                if not rolled:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
@@ -671,6 +793,8 @@ class SingleChipTrainer:
             images_per_sec=timer.total_images / train_time if train_time > 0 else 0.0,
             compile_time_s=compile_time,
             step_stats=timer.stats(),
-            resumed_from_step=start_step,
+            resumed_from_step=resumed_from,
             preempted=preempted,
+            skipped_steps=monitor.skipped_steps if monitor else 0,
+            rollbacks=monitor.rollbacks if monitor else 0,
         )

@@ -1,5 +1,10 @@
 """Auxiliary subsystems the reference lacks entirely (SURVEY.md §5 gap-fill):
 checkpoint/resume, metrics/timing, profiling hooks."""
 
-from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    find_latest_valid,
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
 from .metrics import StepTimer, trace  # noqa: F401

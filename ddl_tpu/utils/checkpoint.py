@@ -8,9 +8,25 @@ params/optimizer pytree, usable from every strategy (sharded state is
 gathered to host before saving, re-placed by the caller's sharding after
 loading).
 
-Atomicity: writes go to a temp file then ``os.replace`` — a crash mid-save
-never corrupts the previous checkpoint (the failure-recovery story the
-reference lacks, SURVEY.md §5 "failure detection: none").
+Durability (ISSUE 6): writes go to a temp file, are ``fsync``'d, then
+``os.replace``'d — a crash (or preemption SIGKILL) mid-save never corrupts
+the previous checkpoint, and a completed save survives power loss. Every
+save also writes a sidecar manifest ``<file>.manifest.json`` with a
+per-array CRC32 so :func:`verify_checkpoint` can detect a torn or
+bit-rotted file WITHOUT trusting the zip container, and
+:func:`find_latest_valid` can auto-discover the newest intact save for
+``--resume auto`` — skipping corrupt/truncated files instead of dying on
+them.
+
+Retention: ``save_checkpoint(..., step=s, keep=N)`` additionally retains
+the last ``N`` saves as ``<stem>-<step:08d>.npz`` (the rolling ``path``
+is a hardlink of the newest — zero extra bytes for the current save), so
+a corrupt LATEST checkpoint still leaves the previous one to resume
+from. One failure window remains by construction: a crash between the
+data replace and the manifest replace leaves a good file with a stale
+manifest — verification then REJECTS a good file, which is the safe
+direction (resume falls back one save instead of loading unverified
+bytes).
 """
 
 from __future__ import annotations
@@ -18,12 +34,14 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
 _META_KEY = "__meta__"
+MANIFEST_SUFFIX = ".manifest.json"
 
 
 def _flatten_with_paths(tree: Any) -> dict[str, np.ndarray]:
@@ -35,29 +53,32 @@ def _flatten_with_paths(tree: Any) -> dict[str, np.ndarray]:
     return out
 
 
-def save_checkpoint(
-    path: str | os.PathLike,
-    tree: Any,
-    *,
-    step: int | None = None,
-    extra: dict | None = None,
-) -> None:
-    """Atomically save a pytree (params, optimizer state, ...) to ``path``.
+def _crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
 
-    Device/sharded arrays are fetched to host. ``extra`` must be
-    JSON-serializable metadata (config echo, accuracy, ...).
-    """
-    arrays = _flatten_with_paths(tree)
-    meta = {"step": step, "extra": extra or {}}
-    d = os.path.dirname(os.fspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    # Suffix must be .npz or np.savez appends one, orphaning the temp path.
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
-    os.close(fd)  # np.savez owns the file (and its ZipFile finalization)
+
+def _fsync_dir(d: str) -> None:
+    """Durability for the rename itself (POSIX: a replace is not durable
+    until the DIRECTORY is synced). Best-effort — some filesystems refuse
+    O_RDONLY fsync on directories."""
     try:
-        np.savez(tmp, **{_META_KEY: np.frombuffer(
-            json.dumps(meta).encode(), dtype=np.uint8
-        )}, **arrays)
+        fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def _write_json_atomic(path: str, payload: dict) -> None:
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -65,17 +86,282 @@ def save_checkpoint(
         raise
 
 
+def manifest_path(path: str | os.PathLike) -> str:
+    return os.fspath(path) + MANIFEST_SUFFIX
+
+
+def _write_npz_atomic(dst: str, arrays: dict[str, np.ndarray],
+                      meta: dict) -> None:
+    d = os.path.dirname(dst) or "."
+    # Suffix must be .npz or np.savez appends one, orphaning the temp path.
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)  # np.savez owns the file (and its ZipFile finalization)
+    try:
+        np.savez(tmp, **{_META_KEY: np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )}, **arrays)
+        with open(tmp, "rb") as f:  # flush the zip to stable storage
+            os.fsync(f.fileno())
+        os.replace(tmp, dst)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _retained_name(path: str, step: int) -> str:
+    stem = path[:-4] if path.endswith(".npz") else path
+    return f"{stem}-{step:08d}.npz"
+
+
+def _retained_files(path: str) -> list[tuple[int, str]]:
+    """Existing retained siblings of ``path``, ascending by step."""
+    stem = os.path.basename(path[:-4] if path.endswith(".npz") else path)
+    d = os.path.dirname(path) or "."
+    out = []
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return []
+    for fn in names:
+        if not (fn.startswith(stem + "-") and fn.endswith(".npz")):
+            continue
+        tail = fn[len(stem) + 1:-4]
+        if tail.isdigit():
+            out.append((int(tail), os.path.join(d, fn)))
+    return sorted(out)
+
+
+def save_checkpoint(
+    path: str | os.PathLike,
+    tree: Any,
+    *,
+    step: int | None = None,
+    extra: dict | None = None,
+    keep: int = 0,
+) -> None:
+    """Atomically save a pytree (params, optimizer state, ...) to ``path``.
+
+    Device/sharded arrays are fetched to host. ``extra`` must be
+    JSON-serializable metadata (config echo, accuracy, ...). Every save
+    writes a ``<path>.manifest.json`` sidecar (per-array CRC32s — the
+    :func:`verify_checkpoint` contract). With ``keep > 0`` and a
+    ``step``, the save is ALSO retained as ``<stem>-<step:08d>.npz``
+    (``path`` becomes a hardlink of it) and older retained saves beyond
+    the newest ``keep`` are pruned — the fallback chain ``--resume
+    auto`` walks when the latest file is corrupt.
+    """
+    path = os.fspath(path)
+    arrays = _flatten_with_paths(tree)
+    meta = {"step": step, "extra": extra or {}}
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    manifest = {
+        "schema": "ddl_tpu.ckpt.v1",
+        "step": step,
+        "arrays": {k: {"crc32": _crc(a), "shape": list(a.shape),
+                       "dtype": str(a.dtype)} for k, a in arrays.items()},
+    }
+    if keep > 0 and step is not None:
+        retained = _retained_name(path, step)
+        _write_npz_atomic(retained, arrays, meta)
+        _write_json_atomic(manifest_path(retained), manifest)
+        # Rolling name = hardlink of the newest retained save (same
+        # inode, zero extra bytes); fall back to an independent write on
+        # filesystems without hardlinks.
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+        os.close(fd)
+        os.unlink(tmp)
+        try:
+            os.link(retained, tmp)
+            os.replace(tmp, path)
+        except OSError:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            _write_npz_atomic(path, arrays, meta)
+        _write_json_atomic(manifest_path(path), manifest)
+        for _, old in _retained_files(path)[:-keep]:
+            for victim in (old, manifest_path(old)):
+                try:
+                    os.unlink(victim)
+                except FileNotFoundError:
+                    pass
+    else:
+        _write_npz_atomic(path, arrays, meta)
+        _write_json_atomic(manifest_path(path), manifest)
+    _fsync_dir(d)
+
+
+def verify_checkpoint(path: str | os.PathLike) -> bool:
+    """True iff ``path`` is a readable checkpoint whose contents match
+    its manifest (per-array CRC32 + the exact array name set). Without a
+    manifest (a pre-ISSUE-6 save), falls back to a full decompression
+    read — which still catches truncation, since the zip directory lives
+    at the END of the file. Never raises."""
+    path = os.fspath(path)
+    man = manifest_path(path)
+    try:
+        if os.path.exists(man):
+            with open(man) as f:
+                m = json.load(f)
+            want = m.get("arrays", {})
+            with np.load(path) as data:
+                names = [k for k in data.files if k != _META_KEY]
+                if set(names) != set(want):
+                    return False
+                for name in names:
+                    if _crc(data[name]) != int(want[name]["crc32"]):
+                        return False
+                json.loads(bytes(data[_META_KEY]).decode())
+            return True
+        with np.load(path) as data:
+            json.loads(bytes(data[_META_KEY]).decode())
+            for name in data.files:
+                data[name]  # force decompression of every member
+        return True
+    except Exception:  # noqa: BLE001 — any unreadable byte means corrupt
+        return False
+
+
+def checkpoint_step(path: str | os.PathLike) -> int | None:
+    """Best-effort step of a checkpoint: the manifest's (cheap), else the
+    in-file meta, else None. Never raises."""
+    path = os.fspath(path)
+    try:
+        with open(manifest_path(path)) as f:
+            s = json.load(f).get("step")
+            return int(s) if s is not None else None
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        with np.load(path) as data:
+            s = json.loads(bytes(data[_META_KEY]).decode()).get("step")
+            return int(s) if s is not None else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def find_latest_valid(
+    checkpoint_dir: str | os.PathLike,
+    *,
+    prefix: str = "ckpt",
+    max_step: int | None = None,
+    log=None,
+) -> tuple[str, int] | None:
+    """Newest intact checkpoint under ``checkpoint_dir`` as
+    ``(path, step)`` — the ``--resume auto`` discovery. Candidates are
+    every ``<prefix>*.npz`` (the rolling file and its retained
+    siblings), ordered newest-step first; corrupt or truncated files are
+    verified out (and reported through ``log``), so one torn save falls
+    back to the previous one instead of bricking the resume.
+    ``max_step`` bounds the search — the guard's rollback uses it to
+    land BEFORE a divergence streak. Returns None when nothing valid
+    exists."""
+    d = os.fspath(checkpoint_dir)
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return None
+    cands = []
+    for fn in names:
+        if not (fn.startswith(prefix) and fn.endswith(".npz")):
+            continue
+        p = os.path.join(d, fn)
+        step = checkpoint_step(p)
+        cands.append((step if step is not None else -1, fn, p))
+    for step, _, p in sorted(cands, reverse=True):
+        if max_step is not None and step > max_step:
+            continue
+        if verify_checkpoint(p):
+            return p, max(step, 0)
+        if log is not None:
+            log(f"[resume] skipping corrupt/unverifiable checkpoint {p}")
+    return None
+
+
+def discard_newer(
+    checkpoint_dir: str | os.PathLike,
+    step: int,
+    *,
+    prefix: str = "ckpt",
+    log=None,
+) -> None:
+    """Remove every retained save NEWER than ``step`` and re-point the
+    rolling file at the newest survivor — the guard's rollback calls
+    this so the abandoned timeline cannot resurface. Without it, a
+    crash between rollback and the replay overtaking the pruned steps
+    would let ``--resume auto`` pick a stale higher-step file whose
+    params never saw the replayed batches (silently lost updates)."""
+    d = os.fspath(checkpoint_dir)
+    rolling = os.path.join(d, prefix + ".npz")
+    for s, p in _retained_files(rolling):
+        if s > step:
+            for victim in (p, manifest_path(p)):
+                try:
+                    os.unlink(victim)
+                except FileNotFoundError:
+                    pass
+            if log is not None:
+                log(f"[guard] discarded post-rollback checkpoint {p}")
+    r_step = checkpoint_step(rolling)
+    if not os.path.exists(rolling) or r_step is None or r_step <= step:
+        return
+    survivors = _retained_files(rolling)
+    if survivors:
+        # Hardlink the newest surviving retained save over the rolling
+        # name (atomic), so plain --resume agrees with --resume auto.
+        newest = survivors[-1][1]
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+        os.close(fd)
+        os.unlink(tmp)
+        try:
+            os.link(newest, tmp)
+            os.replace(tmp, rolling)
+            man = manifest_path(newest)
+            if os.path.exists(man):
+                with open(man) as f:
+                    _write_json_atomic(manifest_path(rolling), json.load(f))
+        except OSError:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    else:
+        for victim in (rolling, manifest_path(rolling)):
+            try:
+                os.unlink(victim)
+            except FileNotFoundError:
+                pass
+    _fsync_dir(d)
+
+
 def _read_tree(data, path, like: Any, prefix: str = "") -> Any:
     """Rebuild ``like``'s structure from an open ``.npz``, reading each
     leaf at ``prefix + keystr(leaf_path)`` — the one flatten/key/shape-
-    check loop behind both full and subtree loads (extra keys in the
-    file are simply never read)."""
+    check loop behind both full and subtree loads. Extra keys in the
+    file are simply never read — UNLESS expected keys are missing, in
+    which case the error names BOTH the path-qualified missing leaves
+    and the file's unexpected keys (the usual cause: a tree from a
+    different strategy family or model config), so the mismatch is
+    diagnosable from the message alone (ISSUE 6 satellite)."""
     flat, _ = jax.tree_util.tree_flatten_with_path(like)
+    expected = [prefix + jax.tree_util.keystr(p) for p, _ in flat]
+    missing = [k for k in expected if k not in data]
+    if missing:
+        known = set(expected) | {_META_KEY}
+        unexpected = sorted(k for k in data.files if k not in known)
+
+        def _fmt(keys):
+            shown = ", ".join(keys[:8])
+            more = f", ... ({len(keys) - 8} more)" if len(keys) > 8 else ""
+            return f"[{shown}{more}]"
+
+        raise KeyError(
+            f"checkpoint {path} does not match the expected tree: "
+            f"{len(missing)} missing leaves {_fmt(missing)}; "
+            f"{len(unexpected)} unexpected keys in the file "
+            f"{_fmt(unexpected)}"
+        )
     leaves = []
-    for p, leaf in flat:
-        key = prefix + jax.tree_util.keystr(p)
-        if key not in data:
-            raise KeyError(f"checkpoint {path} missing leaf {key}")
+    for key, (p, leaf) in zip(expected, flat):
         saved = data[key]
         want = np.shape(leaf)
         if tuple(saved.shape) != tuple(want):

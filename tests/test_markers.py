@@ -1,4 +1,5 @@
-"""Tier-1 marker audit for the serving test surface (ISSUE 4 satellite).
+"""Tier-1 marker audits: the serve-scheduler budget (ISSUE 4 satellite)
+and the fault-injection trainer budget (ISSUE 6 satellite).
 
 Serve tests are the suite's fastest-growing cost center: every scheduler
 run decodes tokens one compiled step at a time, and every topology in a
@@ -167,6 +168,160 @@ def test_serve_scheduler_tests_carry_slow_marker():
         f"(<= {MAX_FAST_TOKENS} tokens, <= {MAX_FAST_TOPOLOGIES} "
         "topologies)"
     )
+
+
+# -- fault-injection trainer audit (ISSUE 6 satellite) ------------------------
+#
+# Resilience tests run WHOLE trainer loops (often several per test: a
+# golden run, a faulted run, a resume run), which dwarfs the serve
+# scheduler's per-token cost. Same mechanical discipline as above: any
+# unmarked test that references the fault-injection surface and either
+# trains more than MAX_FAST_TRAIN_STEPS estimated optimizer steps per
+# test or re-runs more than MAX_FAST_RESUME_CYCLES resume cycles must
+# carry @pytest.mark.slow. The step estimate is a documented LOWER
+# bound: sites * max(epochs) * (max(num_train|synthetic_train) //
+# max(batch_size)), with unresolvable values contributing 1/0 — plain
+# code can never false-positive.
+
+MAX_FAST_TRAIN_STEPS = 64
+MAX_FAST_RESUME_CYCLES = 2
+_FAULT_NAMES = ("FaultSpec", "FaultInjector", "parse_fault",
+                "corrupt_checkpoint", "truncate_checkpoint")
+
+
+def estimate_fault(fn) -> tuple[bool, int, int]:
+    """``(uses_faults, est_train_steps, resume_cycles)`` for one test
+    function's AST. ``uses_faults``: any fault-injection name appears
+    outside pytest.raises blocks. ``est_train_steps``: `.train(` call
+    sites times the largest literal epochs times the largest literal
+    dataset-size // batch-size. ``resume_cycles``: `.train(` calls
+    passing a truthy literal ``resume``."""
+    skip = _raises_nodes(fn)
+    uses = False
+    train_sites = 0
+    resume_cycles = 0
+    epochs = 1
+    ntrain = 0
+    batch = 0
+    for node in ast.walk(fn):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Name) and node.id in _FAULT_NAMES:
+            uses = True
+        if isinstance(node, ast.Attribute) and node.attr in _FAULT_NAMES:
+            uses = True
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "train":
+            train_sites += 1
+            for kw in node.keywords:
+                if kw.arg == "resume" and isinstance(kw.value, ast.Constant) \
+                        and bool(kw.value.value):
+                    resume_cycles += 1
+        for kw in node.keywords:
+            v = _const_int(kw.value)
+            if v is None:
+                continue
+            if kw.arg == "epochs":
+                epochs = max(epochs, v)
+            elif kw.arg in ("num_train", "synthetic_train"):
+                ntrain = max(ntrain, v)
+            elif kw.arg == "batch_size":
+                batch = max(batch, v)
+    per_run = epochs * (ntrain // batch if ntrain and batch else 1)
+    return uses, train_sites * per_run, resume_cycles
+
+
+def _audit_faults(tree) -> list[tuple[str, int, int]]:
+    """Violations ``(test_name, est_steps, resume_cycles)``."""
+    out = []
+    for fn in tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not fn.name.startswith("test"):
+            continue
+        uses, steps, cycles = estimate_fault(fn)
+        if not uses or has_slow_marker(fn):
+            continue
+        if steps > MAX_FAST_TRAIN_STEPS or cycles > MAX_FAST_RESUME_CYCLES:
+            out.append((fn.name, steps, cycles))
+    return out
+
+
+def test_fault_injection_tests_carry_slow_marker():
+    """THE fault audit: every unmarked tier-1 test touching the fault
+    injection surface stays within 64 estimated trainer steps and 2
+    resume cycles; anything bigger must be @pytest.mark.slow."""
+    violations = []
+    for path in sorted(pathlib.Path(__file__).parent.glob("test_*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        violations += [(path.name, *v) for v in _audit_faults(tree)]
+    assert not violations, (
+        "fault-injection tests exceeding the tier-1 budget without "
+        "@pytest.mark.slow (file, test, est_steps, resume_cycles): "
+        f"{violations} — mark them slow or shrink the run "
+        f"(<= {MAX_FAST_TRAIN_STEPS} steps, <= {MAX_FAST_RESUME_CYCLES} "
+        "resume cycles)"
+    )
+
+
+def test_fault_audit_estimator_flags_and_permits():
+    """Self-pin for the fault estimator: step overruns flag, resume-
+    cycle overruns flag, slow-marked / in-budget / non-fault tests are
+    exempt, pytest.raises bodies don't count as fault usage."""
+    src = textwrap.dedent("""
+        import pytest
+
+        def test_step_overrun():
+            inj = FaultInjector(FaultSpec(kind="nan_grads", step=1))
+            ds = synthesize_copy(num_train=640, seq_len=32)
+            cfg = SeqConfig(epochs=4, batch_size=16)
+            SeqTrainer(cfg, ds).train(fault_injector=inj)
+
+        def test_resume_cycle_overrun():
+            inj = FaultInjector(FaultSpec(kind="sigterm", step=1))
+            t = SeqTrainer(SeqConfig(epochs=1, batch_size=16),
+                           synthesize_copy(num_train=16))
+            t.train(fault_injector=inj)
+            t.train(resume=True)
+            t.train(resume="auto")
+            t.train(resume="auto")
+
+        @pytest.mark.slow
+        def test_marked_overrun():
+            corrupt_checkpoint("x")
+            cfg = SeqConfig(epochs=100, batch_size=1)
+            SeqTrainer(cfg, synthesize_copy(num_train=100)).train()
+
+        def test_in_budget():
+            truncate_checkpoint("x")
+            cfg = SeqConfig(epochs=2, batch_size=16)
+            ds = synthesize_copy(num_train=64)
+            SeqTrainer(cfg, ds).train()
+            SeqTrainer(cfg, ds).train(resume="auto")
+
+        def test_raises_only_exempt():
+            with pytest.raises(ValueError):
+                parse_fault("bogus")
+
+        def test_no_faults_big_train():
+            cfg = SeqConfig(epochs=100, batch_size=1)
+            SeqTrainer(cfg, synthesize_copy(num_train=1000)).train()
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit_faults(tree)}
+    assert names == {"test_step_overrun", "test_resume_cycle_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, steps, cycles = estimate_fault(fns["test_step_overrun"])
+    assert uses and steps == 160 and cycles == 0
+    uses, steps, cycles = estimate_fault(fns["test_resume_cycle_overrun"])
+    assert uses and cycles == 3
+    uses, steps, cycles = estimate_fault(fns["test_in_budget"])
+    assert uses and steps == 16 and cycles == 1
+    uses, _, _ = estimate_fault(fns["test_raises_only_exempt"])
+    assert not uses
+    uses, _, _ = estimate_fault(fns["test_no_faults_big_train"])
+    assert not uses
 
 
 def test_audit_estimator_flags_and_permits():
