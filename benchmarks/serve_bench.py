@@ -15,7 +15,7 @@ Per (slots, tensor_parallel) row, the serving SLO set:
   (``utils.metrics.StepTimer`` percentiles)
 - **TTFT p50/p95** — wall clock from arrival-eligibility to first token
 
-Plus two head-to-head sections (ISSUE 4; skip with ``--skip-compare``):
+Plus head-to-head sections (ISSUE 4/7; skip with ``--skip-compare``):
 
 - **prefix_compare** — the shared-prefix workload
   (``synthesize_shared_prefix_prompts``) served with the prefix cache
@@ -26,6 +26,19 @@ Plus two head-to-head sections (ISSUE 4; skip with ``--skip-compare``):
   decode, chunked prefill off vs on: the inter-token-latency (ITL)
   tail is the number chunking exists to bound — one whole-prompt
   prefill between decode ticks IS the decoder stall.
+- **paged_compare** (ISSUE 7) — the shared-prefix workload served by
+  the contiguous slot-major cache vs the paged block-table pool (both
+  with the prefix cache on): same SLO set plus the zero-copy ledger
+  (CoW tail-page copies vs full-prefix row copies) and the pool gauges
+  (``serve_kv_pages_free`` / ``serve_kv_pages_shared``), with the
+  ``tokens_identical`` integrity bit across LAYOUTS.
+- **longtail_compare** (ISSUE 7) — capacity POOLING made concrete: a
+  long-tail prompt mix under one fixed row budget. The slot-major arm
+  (budget / slots rows per slot) must REJECT the long requests at
+  submit — serving them would need a worst-case capacity per slot that
+  multiplies the budget. The paged arm (same rows as one shared pool)
+  admits and completes everything, with hit-rate and pages-free rows
+  read from the registry.
 
 Every row is read from the ``ddl_tpu.obs`` MetricRegistry the
 scheduler publishes (counters + latency histograms observed from the
@@ -72,6 +85,10 @@ def main() -> None:
                     help="shared family-prefix length for prefix_compare")
     ap.add_argument("--prefill-chunk", type=int, default=64,
                     help="chunk size (= per-tick budget) for chunk_compare")
+    ap.add_argument("--page-size", type=int, default=64,
+                    help="KV page size for the paged_compare / "
+                         "longtail_compare arms (a power of two "
+                         "dividing --capacity)")
     ap.add_argument("--compare-repeats", type=int, default=3,
                     help="timed runs per head-to-head arm; the best "
                          "(min ITL p95) is recorded — single shots on "
@@ -106,6 +123,7 @@ def main() -> None:
 
     import bench
     from ddl_tpu.data.lm import (
+        synthesize_longtail_prompts,
         synthesize_prompts,
         synthesize_shared_prefix_prompts,
     )
@@ -263,6 +281,163 @@ def main() -> None:
                   f"{itl.p95_ms:.0f}ms p99 {itl.p99_ms:.0f}ms",
                   file=sys.stderr)
 
+    # -- paged vs contiguous on the shared-prefix workload (ISSUE 7) ------
+    paged_compare = {}
+    longtail_compare = {}
+    ps = args.page_size
+    paged_geom_ok = ps > 0 and not (ps & (ps - 1)) \
+        and args.capacity % ps == 0
+    if not paged_geom_ok:
+        # Loud skip, parseable artifact — a bad geometry must not let
+        # the headline ISSUE 7 sections vanish into `failed` silently.
+        note = (f"--page-size {ps} must be a power of two dividing "
+                f"--capacity {args.capacity}; paged sections skipped")
+        paged_compare["skipped"] = longtail_compare["skipped"] = note
+        print(f"[serve_bench] {note}", file=sys.stderr)
+    if not args.skip_compare and paged_geom_ok:
+        fam_prompts = synthesize_shared_prefix_prompts(
+            n_families=4, per_family=4, prefix_len=args.prefix_len,
+            tail_min=8, tail_max=32, vocab=args.vocab, seed=1,
+        )
+        fam_requests = [
+            Request(id=i, prompt=p, max_new_tokens=24, arrival=i)
+            for i, p in enumerate(fam_prompts)
+        ]
+        completions = {}
+        for label, paged_kw in (
+            ("layout_contiguous", {}),
+            ("layout_paged", {"page_size": ps}),  # num_pages defaults to
+            # the slot-major envelope: SAME rows, so this row isolates
+            # the layout (gather + zero-copy sharing) — the capacity
+            # story is longtail_compare's.
+        ):
+            try:
+                done, reg = _measure(
+                    ServeConfig(**base_cfg, prefix_slots=4, **paged_kw),
+                    fam_requests,
+                )
+            except Exception as e:  # noqa: BLE001 — record, don't discard
+                failed[f"paged_{label}"] = {"error_type": type(e).__name__,
+                                            "error": str(e)[:300]}
+                continue
+            completions[label] = {i: done[i].tokens for i in done}
+            saved = int(
+                reg.counter("serve_prefill_tokens_saved_total").value()
+            )
+            hits = int(reg.counter("serve_prefix_hits_total").value())
+            lookups = int(reg.counter("serve_prefix_lookups_total").value())
+            row = {
+                **_slo(reg),
+                "prefix_hit_rate":
+                    round(hits / lookups, 3) if lookups else 0.0,
+                "prefill_tokens_saved": saved,
+            }
+            if paged_kw:
+                row["kv_pages_free"] = reg.gauge(
+                    "serve_kv_pages_free").value()
+                row["kv_pages_shared"] = reg.gauge(
+                    "serve_kv_pages_shared").value()
+            paged_compare[label] = row
+            print(f"[serve_bench] {label}: itl p95 "
+                  f"{row['itl_ms']['p95']}ms, saved {saved} tok",
+                  file=sys.stderr)
+        if len(completions) == 2:
+            # Bit-exactness ACROSS LAYOUTS, checked in situ.
+            paged_compare["tokens_identical"] = (
+                completions["layout_contiguous"]
+                == completions["layout_paged"]
+            )
+
+        # -- pooled capacity: the long-tail mix under one row budget ------
+        # Budget: 4 slots x capacity/2 rows. Slot-major splits it into
+        # four fixed rings of capacity/2 — the long requests
+        # (long_len + 16 > capacity/2) are REJECTED at submit (serving
+        # them slot-major would need capacity*4 extra rows of
+        # worst-case reservation). The paged arm pools the SAME budget
+        # as one page pool with table reach = capacity: everything
+        # admits, completes, and shares the long family prefix.
+        cap_c = args.capacity // 2
+        budget_rows = 4 * cap_c
+        # Longs must overflow the slot-major ring (> cap_c) while still
+        # fitting the paged arm's table reach (+16 new tokens inside
+        # --capacity) AND clearing the generator's tail contract
+        # (> short_max). Small --capacity values can't host the story —
+        # skip loudly rather than record a vacuous section.
+        long_len = min(cap_c + ps, args.capacity - 16)
+        if long_len <= max(cap_c, 24):
+            note = (f"--capacity {args.capacity} too small for the "
+                    "long-tail story (no long length both exceeds the "
+                    f"slot-major ring {cap_c} and fits the paged reach); "
+                    "longtail_compare skipped")
+            longtail_compare["skipped"] = note
+            print(f"[serve_bench] {note}", file=sys.stderr)
+            lt_prompts = None
+        else:
+            lt_prompts = synthesize_longtail_prompts(
+                num_short=10, num_long=2, short_min=8, short_max=24,
+                long_len=long_len, vocab=args.vocab, seed=4,
+            )
+        if lt_prompts is not None:
+            lt_requests = [
+                Request(id=i, prompt=p, max_new_tokens=16)
+                for i, p in enumerate(lt_prompts)
+            ]
+            longtail_compare["budget_rows"] = budget_rows
+            longtail_compare["long_len"] = long_len
+            try:
+                Scheduler(InferenceEngine(ServeConfig(
+                    spec=spec, slots=4, capacity=cap_c,
+                    temperature=args.temperature,
+                    compute_dtype=base_cfg["compute_dtype"],
+                ))).run(lt_requests)
+                longtail_compare["layout_contiguous"] = {
+                    "unexpectedly_admitted": True
+                }
+            except ValueError as e:
+                longtail_compare["layout_contiguous"] = {
+                    "capacity_per_slot": cap_c,
+                    "rejected": str(e)[:200],
+                    "worst_case_rows_to_admit": 4 * (long_len + 16),
+                }
+                print(f"[serve_bench] longtail contiguous: REJECTED "
+                      f"({cap_c} rows/slot)", file=sys.stderr)
+            try:
+                done, reg = _measure(
+                    ServeConfig(
+                        spec=spec, slots=4, capacity=args.capacity,
+                        temperature=args.temperature,
+                        compute_dtype=base_cfg["compute_dtype"],
+                        prefix_slots=4, page_size=ps,
+                        num_pages=budget_rows // ps,
+                    ),
+                    lt_requests,
+                )
+                hits = int(reg.counter("serve_prefix_hits_total").value())
+                lookups = int(
+                    reg.counter("serve_prefix_lookups_total").value()
+                )
+                longtail_compare["layout_paged"] = {
+                    **_slo(reg),
+                    "num_pages": budget_rows // ps,
+                    "page_size": ps,
+                    "completed_ok": sum(
+                        1 for c in done.values() if c.status == "ok"
+                    ),
+                    "requests": len(lt_requests),
+                    "prefix_hit_rate":
+                        round(hits / lookups, 3) if lookups else 0.0,
+                    "kv_pages_free": reg.gauge("serve_kv_pages_free").value(),
+                    "kv_pages_shared": reg.gauge(
+                        "serve_kv_pages_shared").value(),
+                }
+                print(f"[serve_bench] longtail paged: "
+                      f"{longtail_compare['layout_paged']['completed_ok']}/"
+                      f"{len(lt_requests)} ok under the same "
+                      f"{budget_rows}-row budget", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                failed["longtail_paged"] = {"error_type": type(e).__name__,
+                                            "error": str(e)[:300]}
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -337,8 +512,11 @@ def main() -> None:
         "results": rows,
         "prefix_compare": prefix_compare,
         "chunk_compare": chunk_compare,
+        "paged_compare": paged_compare,
+        "longtail_compare": longtail_compare,
         "prefix_len": args.prefix_len,
         "prefill_chunk": args.prefill_chunk,
+        "page_size": args.page_size,
         "compare_repeats": args.compare_repeats,
         "skipped_for_deadline": skipped,
         "failed": failed,

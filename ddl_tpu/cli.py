@@ -334,6 +334,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="max prefill tokens per scheduler tick when "
                          "chunking (>= --prefill-chunk); 0 = one chunk "
                          "per tick, the maximum-interleaving default")
+    sv.add_argument("--page-size", type=int, default=0, metavar="ROWS",
+                    help="paged KV cache: rows per page (a power of "
+                         "two; --capacity must be a multiple). Replaces "
+                         "the per-slot rings with one shared page pool "
+                         "+ per-slot block tables: admission reserves "
+                         "only the pages a request can actually use "
+                         "(capacity pools across slots), prefix hits "
+                         "share pages zero-copy, and decode programs "
+                         "bucket on page count. Tokens are bit-identical "
+                         "to the contiguous layout. 0 = contiguous "
+                         "(the default, and the bit-exactness oracle)")
+    sv.add_argument("--num-pages", type=int, default=0, metavar="N",
+                    help="paged KV pool size in pages (requires "
+                         "--page-size; must be >= --slots). 0 = "
+                         "slots * capacity / page-size — the slot-major "
+                         "memory envelope, no pooling savings but "
+                         "drop-in; a SMALLER pool is the point: "
+                         "admission becomes 'enough free pages' "
+                         "instead of worst-case rows per slot")
     sv.add_argument("--ttft-deadline", type=float, default=None,
                     metavar="SECONDS",
                     help="default per-request time-to-first-token "
@@ -872,6 +891,8 @@ def _run_serve(args) -> int:
         prefix_slots=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
     )
     if args.top_k and args.temperature <= 0:
         # Same flag hygiene as the variant-group rejects above: greedy
@@ -967,6 +988,10 @@ def _run_serve(args) -> int:
         print(f"prefix cache: {stats.prefix_hits}/{stats.prefix_lookups} "
               f"hits ({stats.prefix_hit_rate:.0%}), "
               f"{stats.prefill_tokens_saved} prefill tokens saved")
+    if args.page_size:
+        print(f"paged pool: {engine.num_pages} pages x {args.page_size} "
+              f"rows, {engine.pages.free} free at exit, "
+              f"{engine.page_copies} CoW tail-page copies")
     if args.json:
         print(json.dumps({
             "variant": "serve",
@@ -991,6 +1016,8 @@ def _run_serve(args) -> int:
             "prefix_lookups": stats.prefix_lookups,
             "prefix_hits": stats.prefix_hits,
             "prefill_tokens_saved": stats.prefill_tokens_saved,
+            "kv_page_copies": engine.page_copies if args.page_size else 0,
+            "kv_pages_free": engine.pages.free if args.page_size else 0,
         }))
     return 0
 

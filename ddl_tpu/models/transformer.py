@@ -338,6 +338,84 @@ def apply_lm_cached(
     return logits, cache_k, cache_v, cache_pos
 
 
+def apply_lm_paged(
+    params: Params,
+    tokens: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    pool_pos: jax.Array,
+    table: jax.Array,
+    spec: LMSpec = LMSpec(),
+    *,
+    positions: jax.Array,
+    flat_rows: jax.Array,
+    compute_dtype=None,
+    row_reduce=None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Incremental forward against the PAGED (block-table) KV pool — the
+    same layer math as :func:`apply_lm_cached`, with the per-slot ring
+    replaced by one shared pool read/written through a block table:
+
+    ``pool_k``/``pool_v [num_layers, pages, page_size, H, D]`` and
+    ``pool_pos [pages, page_size]`` are the shared pool
+    (``ddl_tpu.serve.cache.PagedKVCache``); ``table [B, TP]`` holds each
+    slot's page ids in logical order (``-1`` = unmapped — ``TP`` is the
+    PAGE-COUNT bucket, the compiled program's static key). New tokens
+    write at ``flat_rows [B, T]`` (``ops.kv_cache.table_rows`` of the
+    logical rows — out-of-bounds rows drop, which is how padded bucket
+    tails and inactive decode slots vanish), and attention gathers each
+    slot's pages back into a ``[B, TP * page_size, ...]`` view whose
+    positions travel with the rows (``table_positions``) — so
+    ``ops.kv_cache.attend`` runs UNCHANGED and the masking/eviction
+    semantics are exactly the contiguous cache's.
+
+    Parity contract: bitwise-identical logits to :func:`apply_lm_cached`
+    over the same resident history, at ANY page-count bucket — masked
+    padding contributes exactly 0 (verified on this backend; pinned
+    paged ≡ contiguous through the whole serving stack in
+    tests/test_serve_paged.py). Never differentiated; ``row_reduce`` is
+    the same Megatron ``g`` hook as :func:`apply_lm_cached`."""
+    from ..ops import kv_cache
+
+    if compute_dtype is not None:
+        params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
+    h = params["embed"][tokens]  # [B, T, E]
+    b, t, _ = h.shape
+    pool_pos = kv_cache.write_rows_flat(
+        pool_pos, positions.astype(pool_pos.dtype), flat_rows
+    )
+    k_pos = kv_cache.table_positions(pool_pos, table)  # [B, TP * page]
+    heads = lambda a: a.reshape(b, t, -1, spec.head_dim)
+    reduce_ = row_reduce if row_reduce is not None else (lambda x: x)
+
+    for i, blk in enumerate(params["blocks"]):
+        x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+        q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
+        k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
+        v = heads(x @ blk["wv"])
+        ck = kv_cache.write_rows_flat(pool_k[i], k.astype(pool_k.dtype),
+                                      flat_rows)
+        cv = kv_cache.write_rows_flat(pool_v[i], v.astype(pool_v.dtype),
+                                      flat_rows)
+        pool_k = pool_k.at[i].set(ck)
+        pool_v = pool_v.at[i].set(cv)
+        a = kv_cache.attend(
+            q,
+            kv_cache.gather_pages(ck, table).astype(q.dtype),
+            kv_cache.gather_pages(cv, table).astype(q.dtype),
+            positions, k_pos,
+        )
+        h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
+        x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+        h = h + reduce_(
+            jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
+        ) + blk["b2"]
+
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)
+    return logits, pool_k, pool_v, pool_pos
+
+
 def ce_sums(
     logits: jax.Array, targets: jax.Array, weights: jax.Array
 ) -> tuple[jax.Array, jax.Array]:

@@ -6,10 +6,10 @@ re-attends the whole history — recomputing it per step is O(T^2) per
 token. The standard fix is a **KV cache**: each layer's post-RoPE k and
 pre-projection v rows are written once and re-read on every later step.
 
-This module is the op layer only — two pure functions usable inside
+This module is the op layer only — pure functions usable inside
 ``shard_map`` (the same contract as ``parallel.collectives``); the cache
-*pytree*, its tp sharding and its donation policy live in
-``ddl_tpu.serve.cache``.
+*pytrees* (contiguous slot-major AND the paged block-table pool), their
+tp sharding and their donation policy live in ``ddl_tpu.serve.cache``.
 
 Design decisions:
 
@@ -81,6 +81,82 @@ def copy_prefix(
     c = dst.shape[axis]
     mask = (jnp.arange(c) < n).reshape((c,) + (1,) * (dst.ndim - axis - 1))
     return jnp.where(mask, src, dst)
+
+
+# -- paged (block-table) layout ----------------------------------------------
+#
+# The paged pool (serve.cache.PagedKVCache) replaces per-slot contiguous
+# rings with one shared ``[pages, page_size, ...]`` pool plus a per-slot
+# int32 block table of page indices (``-1`` = unmapped). These three
+# helpers are the whole device-side contract:
+#
+# - logical row ``r`` of a slot lives in pool page ``table[r // page_size]``
+#   at offset ``r % page_size`` (:func:`table_rows` flattens that to a
+#   ``[num_pages * page_size]`` row index, mapping unmapped/out-of-reach
+#   rows OUT OF BOUNDS so scatters drop them — the same drop discipline
+#   offset prefill already relies on);
+# - reads gather whole pages through the table (:func:`gather_pages`) and
+#   positions gather alongside with ``PAD_POS`` where the table is
+#   unmapped (:func:`table_positions`), so :func:`attend` runs UNCHANGED
+#   on the gathered view: positions still travel with rows, masking and
+#   eviction semantics are exactly the contiguous ring's. Pages appear in
+#   table order = logical order, and masked padding contributes exactly 0
+#   to the fp32 softmax/einsum, so a page-count-bucketed attend is
+#   bitwise equal to the contiguous attend over the same history
+#   (verified on this XLA:CPU before building; pinned in
+#   tests/test_serve_paged.py).
+
+
+def table_rows(
+    table: jax.Array, logical: jax.Array, page_size: int, num_pages: int
+) -> jax.Array:
+    """Flat pool row indices for per-slot LOGICAL rows ``logical [B, T]``
+    through block table ``table [B, TP]`` (int32 page ids, ``-1`` =
+    unmapped). Rows whose page is unmapped or beyond the table reach
+    (``logical >= TP * page_size`` — callers signal "drop this write"
+    that way) map to ``num_pages * page_size``: out of bounds, so the
+    scatter drops them."""
+    tp = table.shape[1]
+    page = logical // page_size
+    pid = jnp.take_along_axis(table, jnp.clip(page, 0, tp - 1), axis=1)
+    ok = (logical >= 0) & (page < tp) & (pid >= 0)
+    return jnp.where(ok, pid * page_size + logical % page_size,
+                     num_pages * page_size)
+
+
+def gather_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Per-slot contiguous K/V view ``[B, TP * page_size, ...]`` gathered
+    from ``pool [pages, page_size, ...]`` through ``table [B, TP]``.
+    Unmapped (``-1``) entries clamp to page 0 — their VALUES are live
+    data of some other slot, which is exactly why masking happens on
+    :func:`table_positions`' ``PAD_POS``, never on the gathered values."""
+    g = pool[jnp.maximum(table, 0)]  # [B, TP, page, ...]
+    return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+
+
+def table_positions(pos: jax.Array, table: jax.Array) -> jax.Array:
+    """Positions travelling with the gathered rows: ``pos [pages,
+    page_size]`` through ``table [B, TP]`` -> ``[B, TP * page_size]``,
+    ``PAD_POS`` wherever the table is unmapped — the gathered twin of
+    the contiguous cache's ``pos`` rows, so :func:`attend` masks the
+    paged view exactly as it masks the ring."""
+    g = jnp.where((table >= 0)[..., None], pos[jnp.maximum(table, 0)],
+                  PAD_POS)
+    return g.reshape(g.shape[0], -1)
+
+
+def write_rows_flat(pool: jax.Array, new: jax.Array,
+                    flat: jax.Array) -> jax.Array:
+    """Write ``new [B, T, ...]`` into ``pool [pages, page_size, ...]``
+    at FLAT row indices ``flat [B, T]`` (from :func:`table_rows`). All
+    slots scatter into the ONE shared pool — distinct rows are the
+    allocator's invariant (disjoint pages per slot; shared prefix pages
+    are never written while shared). Out-of-bounds rows drop."""
+    p, page = pool.shape[:2]
+    out = pool.reshape((p * page,) + pool.shape[2:]).at[
+        flat.reshape(-1)
+    ].set(new.reshape((-1,) + new.shape[2:]))
+    return out.reshape(pool.shape)
 
 
 def attend(

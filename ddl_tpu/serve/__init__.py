@@ -1,10 +1,13 @@
 """Serving: KV-cache autoregressive decode with tp-sharded continuous
 batching — the inference half of the sharded-mesh story.
 
-- ``serve.cache``     — the slot-major ring-buffer KV cache pytree
+- ``serve.cache``     — the KV cache pytrees: slot-major rings AND the
+  paged block-table pool (+ its host PagePool allocator)
 - ``serve.engine``    — the jitted (prefill, decode) pair on the tp mesh
-- ``serve.prefix``    — host prefix-cache index (trie + refcounted LRU)
-- ``serve.scheduler`` — continuous batching over the engine
+- ``serve.prefix``    — host prefix-cache index (trie + refcounted LRU;
+  paged entries own refcounted page lists — zero-copy sharing)
+- ``serve.scheduler`` — continuous batching over the engine (paged mode
+  admits by free pages, pooling capacity across slots)
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
 

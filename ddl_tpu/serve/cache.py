@@ -1,7 +1,11 @@
-"""The serving KV cache: a slot-major ring-buffer pytree on the tp mesh.
+"""The serving KV caches on the tp mesh: the slot-major ring-buffer
+pytree (:class:`KVCache` — the bit-exactness oracle, default) and the
+PAGED block-table pool (:class:`PagedKVCache` + :class:`PagePool` —
+``ServeConfig.page_size > 0``), which pools capacity across slots and
+makes prefix reuse zero-copy (refcounted page sharing).
 
-State layout (one pytree, donated through every decode step so serving
-is allocation-free after warmup):
+Slot-major state layout (one pytree, donated through every decode step
+so serving is allocation-free after warmup):
 
 - ``k``/``v [num_layers, slots, capacity, num_heads, head_dim]`` — the
   per-layer ring buffers of ``ops.kv_cache``, stacked layer-major so
@@ -24,6 +28,7 @@ stays replicated.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 
 import jax
 import jax.numpy as jnp
@@ -112,3 +117,194 @@ def cache_specs(tensor_parallel: int) -> KVCache:
     kv = (P(None, None, None, TP_AXIS, None)
           if tensor_parallel > 1 else P())
     return KVCache(k=kv, v=kv, pos=P())
+
+
+# -- paged (block-table) layout ----------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """The PAGED serving cache: ONE shared K/V pool of fixed-size pages
+    instead of per-slot worst-case rings. Capacity pools across slots —
+    a slot holds exactly the pages its sequence needs, mapped through a
+    host-side block table (``serve.engine``), so one long request no
+    longer reserves ``capacity`` rows for every co-resident, and prefix
+    reuse becomes page SHARING (refcounts, ``serve.prefix``) instead of
+    row copies.
+
+    - ``k``/``v [num_layers, num_pages, page_size, num_heads, head_dim]``
+      — the pool, layer-major like :class:`KVCache` so donation/sharding
+      cover it with one leaf each; head dim tp-sharded identically.
+    - ``pos [num_pages, page_size]`` — the absolute position each pool
+      row holds, shared by all layers; ``PAD_POS`` = unwritten. The
+      free-list invariant (``PagePool``): every UNMAPPED page is fully
+      ``PAD_POS`` (pages reset when their last reference drops), so a
+      freshly mapped page can never leak its previous occupant's
+      positions into the gathered attend view.
+    """
+
+    k: jax.Array  # [L, P, page, H, D]
+    v: jax.Array  # [L, P, page, H, D]
+    pos: jax.Array  # [P, page] int32, PAD_POS = unwritten
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def host_paged_cache(
+    spec: LMSpec, num_pages: int, page_size: int, dtype=np.float32
+) -> PagedKVCache:
+    """Fresh host-side paged pool: zero k/v, every row ``PAD_POS`` (the
+    free-list invariant holds from birth). Placed with
+    ``multihost.put_tree(mesh, paged_cache_specs(tp), ...)``."""
+    shape = (spec.num_layers, num_pages, page_size,
+             spec.num_heads, spec.head_dim)
+    return PagedKVCache(
+        k=np.zeros(shape, dtype),
+        v=np.zeros(shape, dtype),
+        pos=np.full((num_pages, page_size), PAD_POS, np.int32),
+    )
+
+
+def paged_cache_specs(tensor_parallel: int) -> PagedKVCache:
+    """PartitionSpec pytree for the paged pool: same head-dim tp
+    sharding as :func:`cache_specs` (the pool's page axis is a memory
+    axis, never a mesh axis); ``pos`` replicated."""
+    kv = (P(None, None, None, TP_AXIS, None)
+          if tensor_parallel > 1 else P())
+    return PagedKVCache(k=kv, v=kv, pos=P())
+
+
+def copy_page(
+    pool: PagedKVCache,
+    *,
+    src_page: jax.Array,
+    dst_page: jax.Array,
+    n: jax.Array,
+) -> PagedKVCache:
+    """Copy the first ``n`` rows (K/V of every layer + positions) of
+    ``src_page`` into ``dst_page`` — the ONLY copy on the paged prefix
+    path: a hit whose depth is not page-aligned copy-on-writes the one
+    PARTIAL boundary page (the new occupant must own it to write its own
+    tail rows); every full page is shared by table mapping, zero-copy.
+    Destination rows ``>= n`` reset to ``PAD_POS`` (the free-list
+    invariant for the fresh page). All indices traced — one compiled
+    program. Head-dim tp sharding is row-local: no collective needed."""
+    sk = lax.dynamic_slice_in_dim(pool.k, src_page, 1, axis=1)
+    sv = lax.dynamic_slice_in_dim(pool.v, src_page, 1, axis=1)
+    sp = lax.dynamic_slice_in_dim(pool.pos, src_page, 1, axis=0)
+    dk = lax.dynamic_slice_in_dim(pool.k, dst_page, 1, axis=1)
+    dv = lax.dynamic_slice_in_dim(pool.v, dst_page, 1, axis=1)
+    rows = jnp.arange(pool.pos.shape[1])
+    new_pos = jnp.where(rows < n, sp[0], PAD_POS)[None, :].astype(
+        pool.pos.dtype
+    )
+    return PagedKVCache(
+        k=lax.dynamic_update_slice_in_dim(
+            pool.k, copy_prefix(dk, sk, n, axis=2), dst_page, axis=1
+        ),
+        v=lax.dynamic_update_slice_in_dim(
+            pool.v, copy_prefix(dv, sv, n, axis=2), dst_page, axis=1
+        ),
+        pos=lax.dynamic_update_slice_in_dim(
+            pool.pos, new_pos, dst_page, axis=0
+        ),
+    )
+
+
+class PagePool:
+    """Host-side page allocator for the paged pool: free list, per-page
+    refcounts, and admission RESERVATIONS — the whole "enough free
+    pages" capacity story lives here, in plain Python (the device never
+    sees allocation, only tables).
+
+    - **Refcounts**: a page is held by every slot whose table maps it
+      AND every prefix entry that registered it — zero-copy sharing is
+      just ``incref``. The last ``decref`` frees the page; the caller
+      (``serve.engine``) then resets its ``pos`` rows to ``PAD_POS`` on
+      device (the free-list invariant ``PagedKVCache`` documents).
+    - **Reservations**: the scheduler admits a request only when
+      ``available`` (free minus already-promised) covers its worst case
+      ``ceil((prompt + max_new) / page_size)`` minus the pages a prefix
+      hit shares — so admission can never deadlock mid-decode, while
+      capacity still pools ACROSS requests (the slot-major layout
+      reserved ``capacity`` rows per slot unconditionally).
+    - **Deterministic**: the free list pops lowest page id first, so a
+      replayed request sequence maps identical pages — the paged twin
+      of the prefix index's logical-clock LRU.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"page pool needs >= 1 page, got {num_pages}")
+        self.num_pages = num_pages
+        # Min-heap: alloc pops the LOWEST free id (deterministic maps),
+        # frees push back in O(log P).
+        self._free = list(range(num_pages))
+        self.refs = np.zeros(num_pages, np.int32)
+        self.reserved = 0
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    @property
+    def available(self) -> int:
+        """Free pages not promised to an admitted request."""
+        return len(self._free) - self.reserved
+
+    @property
+    def shared(self) -> int:
+        """Pages held by more than one reader (slots + prefix entries)."""
+        return int((self.refs >= 2).sum())
+
+    def reserve(self, n: int) -> None:
+        if n > self.available:
+            raise RuntimeError(
+                f"reserving {n} pages with only {self.available} available "
+                f"({self.free} free, {self.reserved} already reserved) — "
+                "admission must check availability first"
+            )
+        self.reserved += n
+
+    def unreserve(self, n: int) -> None:
+        if n > self.reserved:
+            raise RuntimeError(
+                f"unreserving {n} of {self.reserved} reserved pages"
+            )
+        self.reserved -= n
+
+    def alloc(self) -> int:
+        """Pop the lowest free page id at refcount 1. The caller owns
+        the reservation bookkeeping (``serve.engine._map_page``)."""
+        if not self._free:
+            raise RuntimeError("page pool exhausted (no free pages)")
+        page = heapq.heappop(self._free)
+        self.refs[page] = 1
+        return page
+
+    def incref(self, page: int) -> None:
+        if self.refs[page] < 1:
+            # Increfing a free page would resurrect it while it sits in
+            # the free list — double allocation. Sharing is only legal
+            # on live pages (a mapping slot or a registering entry
+            # already holds one reference).
+            raise RuntimeError(f"incref on free page {page}")
+        self.refs[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; True when the page just freed (the
+        caller must reset its device ``pos`` rows before reuse)."""
+        if self.refs[page] < 1:
+            raise RuntimeError(f"decref on free page {page}")
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            heapq.heappush(self._free, page)
+            return True
+        return False

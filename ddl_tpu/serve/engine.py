@@ -49,6 +49,25 @@ rows are bit-identical to the rows a fresh prefill would write, so the
 determinism contract survives reuse exactly (pinned cache-on vs
 cache-off in tests/test_serve.py).
 
+**Paged KV pool** (``page_size > 0``; ISSUE 7 tentpole): the per-slot
+rings become ONE shared ``[L, pages, page_size, H, D]`` pool plus a
+host-side int32 block table per slot — attention gathers each slot's
+pages back through the table (positions travel with pool rows, so
+masking/eviction semantics are unchanged), writes route through it, and
+the pool's capacity is POOLED across slots: admission is "enough free
+pages" for ``prompt + max_new`` (host accounting, ``cache.PagePool``)
+instead of a worst-case ``capacity`` reservation per slot. Decode
+programs bucket on PAGE COUNT (powers of two capped at the table width)
+so the per-token attend cost tracks actual residency. On this pool the
+prefix cache is ZERO-COPY: registration donates the slot's full prompt
+pages to the index entry (refcount, no snapshot), a hit maps those
+pages into the new slot's table, and only a non-page-aligned hit
+copy-on-writes the one partial boundary page (``page_copies`` counts
+them — the zero-copy acceptance pin). The contiguous path is retained
+as the bit-exactness ORACLE: paged decode is pinned bit-identical to
+it, tokens and per-step logits, tp=1 and tp=2
+(tests/test_serve_paged.py).
+
 Tensor parallelism reuses the training plumbing wholesale: params
 placed by ``models.partition.lm_param_specs``, the cache's head dim
 sharded by ``serve.cache.cache_specs``, and the row-sharded matmul
@@ -73,7 +92,17 @@ from ..ops.kv_cache import PAD_POS
 from ..parallel import collectives as coll
 from ..parallel import multihost
 from ..parallel.mesh import TP_AXIS, donation_for, make_mesh
-from .cache import KVCache, cache_specs, copy_slot_prefix, host_cache
+from .cache import (
+    KVCache,
+    PagedKVCache,
+    PagePool,
+    cache_specs,
+    copy_page,
+    copy_slot_prefix,
+    host_cache,
+    host_paged_cache,
+    paged_cache_specs,
+)
 from .prefix import PrefixIndex
 
 
@@ -83,13 +112,30 @@ class ServeConfig:
     batching width (concurrent sequences); ``capacity`` bounds each
     slot's prompt + generated length (the KV ring's row count).
 
-    ``prefix_slots`` sizes the prefix-cache pool (0 = off).
-    ``prefill_chunk`` (0 = off; else a power of two >= 8, ONE more
-    bucket — not per-length programs) splits prompts into fixed chunks
-    the scheduler interleaves with decode ticks; ``prefill_budget``
-    caps prefill tokens per scheduler tick (0 = one chunk per tick,
-    the maximum-interleaving default; requires chunking, and must be
-    >= the chunk so every tick can make progress)."""
+    ``prefix_slots`` sizes the prefix-cache pool (0 = off): dedicated
+    contiguous pool slots by default, or the maximum RESIDENT PREFIX
+    ENTRY count in paged mode (entries hold refcounted page lists, not
+    slots). ``prefill_chunk`` (0 = off; else a power of two >= 8, ONE
+    more bucket — not per-length programs) splits prompts into fixed
+    chunks the scheduler interleaves with decode ticks;
+    ``prefill_budget`` caps prefill tokens per scheduler tick (0 = one
+    chunk per tick, the maximum-interleaving default; requires
+    chunking, and must be >= the chunk so every tick can make progress).
+
+    ``page_size > 0`` switches the KV cache to the PAGED block-table
+    layout (``serve.cache.PagedKVCache``): one shared pool of
+    ``num_pages`` fixed-size pages replaces the per-slot rings —
+    capacity pools across slots (admission becomes "enough free pages"
+    instead of a worst-case ``capacity`` reservation per slot), prefix
+    hits share pages zero-copy by refcount, and decode programs bucket
+    on PAGE COUNT so attention cost tracks actual residency, not
+    ``capacity``. ``capacity`` still bounds one slot's reach
+    (``capacity // page_size`` block-table entries). ``num_pages = 0``
+    defaults to ``slots * capacity / page_size`` — the slot-major
+    memory envelope, no pooling savings but drop-in. The contiguous
+    path (``page_size = 0``, the default) is retained as the
+    bit-exactness oracle: paged decode is PINNED bit-identical to it
+    (tests/test_serve_paged.py)."""
 
     spec: LMSpec = LMSpec()
     slots: int = 4
@@ -102,6 +148,8 @@ class ServeConfig:
     prefix_slots: int = 0  # prefix-cache pool width; 0 = off
     prefill_chunk: int = 0  # chunked-prefill block; 0 = whole-prompt
     prefill_budget: int = 0  # prefill tokens per scheduler tick; 0 = all
+    page_size: int = 0  # paged KV layout: rows per page; 0 = contiguous
+    num_pages: int = 0  # paged pool size; 0 = slots * capacity / page_size
 
     def dtype(self):
         return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
@@ -176,6 +224,41 @@ class InferenceEngine:
                     f"prefill_budget ({config.prefill_budget}) below "
                     f"prefill_chunk ({ck}) could never start a chunk"
                 )
+        # Paged-layout config (loud-ctor discipline, ISSUE 7 satellite):
+        # a malformed page geometry is a config error here, never a
+        # mid-run surprise.
+        ps = config.page_size
+        if ps < 0 or (ps and ps & (ps - 1)):
+            raise ValueError(
+                f"page_size must be 0 (contiguous) or a power of two, "
+                f"got {ps} (pages tile the capacity and the row->page "
+                "split is a shift/mask)"
+            )
+        if config.num_pages and not ps:
+            raise ValueError(
+                f"num_pages ({config.num_pages}) requires page_size > 0 "
+                "(the contiguous layout has no page pool)"
+            )
+        if config.num_pages < 0:
+            raise ValueError(f"num_pages must be >= 0, got {config.num_pages}")
+        self.paged = ps > 0
+        if self.paged:
+            if config.capacity % ps:
+                raise ValueError(
+                    f"capacity ({config.capacity}) must be a multiple of "
+                    f"page_size ({ps}) — the block table holds whole pages"
+                )
+            self.page_size = ps
+            self.max_pages = config.capacity // ps  # block-table width
+            self.num_pages = config.num_pages or config.slots * self.max_pages
+            if self.num_pages < config.slots:
+                raise ValueError(
+                    f"num_pages ({self.num_pages}) below slots "
+                    f"({config.slots}) — every admitted slot needs at "
+                    "least one page; the pool could never fill the batch"
+                )
+        else:
+            self.page_size = self.max_pages = self.num_pages = 0
         self.config = config
         # A 1-D tp mesh: serving has no data/sequence axis — the batch
         # dim is the slot dim, resident whole on every tp member.
@@ -190,8 +273,13 @@ class InferenceEngine:
         self._row_reduce = coll.tp_allreduce(TP_AXIS) if tp > 1 else None
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._decode_paged_fns: dict[int, object] = {}
         self._copy_in = None  # pool slot -> cache slot (prefix hit)
         self._copy_out = None  # cache slot -> pool slot (registration)
+        self._copy_page_fn = None  # paged CoW: partial tail page
+        self._reset_pages_fn = None  # paged: PAD_POS freed pages' pos
+        if self.paged:
+            self._pcspecs = paged_cache_specs(tp)
         self.pool: KVCache | None = None
         self.prefix: PrefixIndex | None = None
         self.reset()
@@ -210,8 +298,29 @@ class InferenceEngine:
         """Fresh (empty) cache — every slot free, nothing attendable.
         The prefix pool and its host index reset TOGETHER (an index
         entry without its device rows, or vice versa, would be
-        corruption by construction)."""
+        corruption by construction). Paged mode rebuilds the page pool,
+        the block tables and the allocator as one unit for the same
+        reason."""
         dtype = np.dtype(self.config.compute_dtype or np.float32)
+        if self.paged:
+            self.cache = multihost.put_tree(
+                self.mesh, self._pcspecs,
+                host_paged_cache(self.config.spec, self.num_pages,
+                                 self.page_size, dtype),
+            )
+            self.pages = PagePool(self.num_pages)
+            self.tables = np.full(
+                (self.config.slots, self.max_pages), -1, np.int32
+            )
+            self.table_len = np.zeros(self.config.slots, np.int64)
+            self.reserved_for = np.zeros(self.config.slots, np.int64)
+            self.page_copies = 0  # CoW tail copies — the zero-copy pin
+            if self.config.prefix_slots > 0:
+                self.prefix = PrefixIndex(
+                    self.config.prefix_slots,
+                    on_evict=lambda e: self._release_pages(e.pages),
+                )
+            return
         self.cache = multihost.put_tree(
             self.mesh, self._cspecs,
             host_cache(self.config.spec, self.config.slots,
@@ -224,6 +333,110 @@ class InferenceEngine:
                            self.config.capacity, dtype),
             )
             self.prefix = PrefixIndex(self.config.prefix_slots)
+
+    # -- paged page management (host half) ---------------------------------
+
+    def pages_needed(self, rows: int) -> int:
+        """Worst-case page count for ``rows`` resident rows."""
+        return -(-rows // self.page_size)
+
+    def reserve_pages(self, slot: int, n: int) -> None:
+        """Admission promise: hold ``n`` pages of headroom for ``slot``
+        so its prefill chunks and decode page-boundary crossings can
+        never find the pool empty mid-flight. Consumed page-by-page as
+        the slot actually maps them; the remainder releases with the
+        slot (``release_slot``)."""
+        self.pages.reserve(n)
+        self.reserved_for[slot] += n
+
+    def reclaim_pages(self, need: int) -> bool:
+        """Evict zero-ref prefix entries (LRU-first) until ``need``
+        pages are available, dropping their page references — shared
+        pages whose last holder was the entry return to the free list.
+        Only entries whose eviction would actually FREE a page are
+        candidates (an entry whose every page is still mapped by a live
+        slot frees nothing now — evicting it would just burn future
+        hits; its pages free naturally when the slots finish). False
+        when no candidate can reach the target."""
+
+        def frees(e) -> bool:
+            return any(int(self.pages.refs[int(p)]) == 1
+                       for p in set(e.pages))
+
+        while self.pages.available < need:
+            if self.prefix is None or self.prefix.evict_lru(frees) is None:
+                return False
+        return True
+
+    def _map_page(self, slot: int) -> int:
+        """Append one freshly allocated page to ``slot``'s block table,
+        consuming the slot's admission reservation when it has one
+        (direct engine use — tests, warmup — allocates unreserved)."""
+        if self.reserved_for[slot] > 0:
+            self.reserved_for[slot] -= 1
+            self.pages.unreserve(1)
+        elif self.pages.available < 1:
+            raise RuntimeError(
+                f"slot {slot}: page pool exhausted (free "
+                f"{self.pages.free}, reserved {self.pages.reserved}) — "
+                "admission must reserve before the slot grows"
+            )
+        page = self.pages.alloc()
+        t = int(self.table_len[slot])
+        self.tables[slot, t] = page
+        self.table_len[slot] = t + 1
+        return page
+
+    def _ensure_rows(self, slot: int, rows: int) -> None:
+        """Map pages so logical rows ``[0, rows)`` of ``slot`` are
+        writable. Reach is bounded by the table width (validated at
+        submit — ``scheduler._validate``)."""
+        need = self.pages_needed(rows)
+        if need > self.max_pages:
+            raise ValueError(
+                f"slot {slot}: {rows} rows need {need} pages, table "
+                f"reach is {self.max_pages} pages "
+                f"({self.config.capacity} rows)"
+            )
+        while int(self.table_len[slot]) < need:
+            self._map_page(slot)
+
+    def _release_pages(self, pages) -> None:
+        """Drop one reference per page; pages hitting zero return to
+        the free list AND get their device ``pos`` rows reset to
+        ``PAD_POS`` (one batched scatter — the free-list invariant that
+        lets a freshly mapped page join the gathered attend view with
+        nothing attendable)."""
+        freed = [p for p in pages if self.pages.decref(int(p))]
+        while freed:
+            batch, freed = freed[: self.max_pages], freed[self.max_pages:]
+            ids = np.full(self.max_pages, self.num_pages, np.int32)
+            ids[: len(batch)] = batch  # padding is out of bounds: dropped
+            if self._reset_pages_fn is None:
+                self._reset_pages_fn = jax.jit(
+                    lambda cache, pages: PagedKVCache(
+                        k=cache.k, v=cache.v,
+                        pos=cache.pos.at[pages].set(PAD_POS),
+                    ),
+                    donate_argnums=donation_for(self.mesh, 0),
+                )
+            self.cache = self._reset_pages_fn(self.cache, jnp.asarray(ids))
+
+    def release_slot(self, slot: int) -> None:
+        """Free ``slot``'s residency: drop its page references (shared
+        prefix pages survive on the entry's reference), clear its block
+        table, and return any unused admission reservation — eviction
+        and completion are the same host bookkeeping, exactly like the
+        contiguous path's pos masking."""
+        n = int(self.table_len[slot])
+        pages = [int(p) for p in self.tables[slot, :n]]
+        self.tables[slot, :] = -1
+        self.table_len[slot] = 0
+        left = int(self.reserved_for[slot])
+        if left:
+            self.pages.unreserve(left)
+            self.reserved_for[slot] = 0
+        self._release_pages(pages)
 
     def load_params(self, path) -> None:
         """Params-only checkpoint load (``utils.checkpoint.load_params``):
@@ -380,6 +593,139 @@ class InferenceEngine:
         )
         return self._decode_fn
 
+    # -- paged compiled programs -------------------------------------------
+
+    def _prefill_paged_fn(self, bucket: int):
+        """Paged prefill for prompt blocks padded to ``bucket`` tokens:
+        ``(params, pool, tokens [1, bucket], length, base,
+        table [1, max_pages], request_id) -> (next_token,
+        logits [bucket, vocab], pool)``. Same sampling/offset contract
+        as the contiguous ``_prefill_fn`` — writes route through the
+        slot's block table instead of a slot slice, padded tails map
+        OUT OF BOUNDS (dropped), and the table is passed at its FULL
+        width (prefill is matmul-bound; the page-count bucket ladder is
+        the DECODE program's lever, where attend length is the per-token
+        cost)."""
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg = self.config
+        ps, num_pages = self.page_size, self.num_pages
+        reach = self.max_pages * ps
+        from ..ops import kv_cache as kvc
+
+        def shard_body(params, pool: PagedKVCache, tokens, length, base,
+                       table):
+            t = jnp.arange(bucket, dtype=jnp.int32)
+            real = t < length
+            positions = jnp.where(real, base + t, PAD_POS)[None, :]
+            # Padded tails get logical row = reach -> beyond the table
+            # -> flat row num_pages * ps -> the scatter DROPS them (the
+            # same drop discipline the contiguous offset prefill uses).
+            logical = jnp.where(real, base + t, reach)[None, :]
+            flat = kvc.table_rows(table, logical, ps, num_pages)
+            logits, k, v, pos = transformer.apply_lm_paged(
+                params, tokens, pool.k, pool.v, pool.pos, table, cfg.spec,
+                positions=positions, flat_rows=flat,
+                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+            )
+            return logits[0], PagedKVCache(k=k, v=v, pos=pos)
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pspecs, self._pcspecs, P_(), P_(), P_(), P_()),
+            out_specs=(P_(), self._pcspecs),
+            check_vma=False,
+        )
+
+        def run(params, pool, tokens, length, base, table, request_id):
+            logits, pool = shard(params, pool, tokens, length, base, table)
+            last = lax.dynamic_index_in_dim(
+                logits, length - 1, axis=0, keepdims=False
+            )
+            nxt = self._sample(last, request_id, base + length)
+            return nxt, logits, pool
+
+        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode_paged(self, pages: int):
+        """Paged decode at page-count bucket ``pages`` — THE paged perf
+        lever: attention gathers ``pages * page_size`` rows per slot
+        instead of ``capacity``, so per-token cost tracks what the batch
+        actually holds. One compiled program per bucket (powers of two
+        capped at the table width), same sampling contract as the
+        contiguous ``_decode``. Inactive slots' writes map out of
+        bounds and DROP — a mid-prefill or free slot touches nothing."""
+        if pages in self._decode_paged_fns:
+            return self._decode_paged_fns[pages]
+        cfg = self.config
+        ps, num_pages = self.page_size, self.num_pages
+        from ..ops import kv_cache as kvc
+
+        def shard_body(params, pool, last_tokens, lengths, active, table):
+            positions = jnp.where(active, lengths, PAD_POS)[:, None]
+            logical = jnp.where(active, lengths, pages * ps)[:, None]
+            flat = kvc.table_rows(table, logical, ps, num_pages)
+            logits, k, v, pos = transformer.apply_lm_paged(
+                params, last_tokens[:, None], pool.k, pool.v, pool.pos,
+                table, cfg.spec, positions=positions, flat_rows=flat,
+                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+            )
+            return logits[:, 0], PagedKVCache(k=k, v=v, pos=pos)
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pspecs, self._pcspecs, P_(), P_(), P_(), P_()),
+            out_specs=(P_(), self._pcspecs),
+            check_vma=False,
+        )
+
+        def run(params, pool, last_tokens, lengths, request_ids, active,
+                table):
+            logits, pool = shard(params, pool, last_tokens, lengths,
+                                 active, table)
+            nxt = jax.vmap(self._sample)(logits, request_ids, lengths + 1)
+            return nxt, logits, pool
+
+        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        self._decode_paged_fns[pages] = fn
+        return fn
+
+    def _copy_page(self):
+        """Compiled CoW tail-page copy (``serve.cache.copy_page``): the
+        ONLY copy program on the paged prefix path. Slot/page ids and
+        the row count are traced — one program total."""
+        if self._copy_page_fn is not None:
+            return self._copy_page_fn
+
+        def shard_body(pool, src_page, dst_page, n):
+            return copy_page(pool, src_page=src_page, dst_page=dst_page,
+                             n=n)
+
+        P_ = jax.sharding.PartitionSpec
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pcspecs, P_(), P_(), P_()),
+            out_specs=self._pcspecs,
+            check_vma=False,
+        )
+        self._copy_page_fn = jax.jit(
+            shard, donate_argnums=donation_for(self.mesh, 0)
+        )
+        return self._copy_page_fn
+
+    def decode_page_bucket(self, pages: int) -> int:
+        """The page-count bucket ladder: smallest power of two >=
+        ``pages``, capped at the table width — a handful of compiled
+        decode programs cover every residency."""
+        b = 1
+        while b < pages:
+            b *= 2
+        return min(b, self.max_pages)
+
     # -- prefix-cache device half ------------------------------------------
 
     def _copy_fn(self, *, into_cache: bool):
@@ -418,29 +764,89 @@ class InferenceEngine:
             self._copy_out = fn
         return fn
 
-    def prefix_fetch(self, entry_id: int, n: int, slot: int) -> None:
-        """HIT: copy the first ``n`` rows of pool entry ``entry_id``
-        into decode ``slot`` and pin the entry (refcount) until the
-        caller releases it — LRU pressure can never free a prefix a
-        live request was admitted from."""
+    def prefix_fetch(self, entry_id: int, n: int, slot: int) -> int:
+        """HIT: make the first ``n`` rows of entry ``entry_id`` resident
+        in decode ``slot`` and pin the entry (refcount) until the caller
+        releases it — LRU pressure can never free a prefix a live
+        request was admitted from. Returns the number of K/V rows
+        DEVICE-COPIED for the hit.
+
+        Contiguous mode: one donated gather program copies all ``n``
+        rows pool -> slot (returns ``n``). Paged mode: the entry's full
+        pages map straight into the slot's block table (incref — ZERO
+        copies); only when ``n`` is not page-aligned does the one
+        PARTIAL boundary page copy-on-write into a freshly mapped page
+        (returns ``n % page_size`` — the ``page_copies`` counter and
+        the scheduler's trace events assert exactly this bound)."""
         e = self.prefix.entry(entry_id)
+        if self.paged:
+            ps = self.page_size
+            shared, tail = n // ps, n % ps
+            if int(self.table_len[slot]):
+                raise RuntimeError(
+                    f"prefix_fetch into non-empty slot {slot} (admission "
+                    "maps shared pages into a fresh table only)"
+                )
+            for i in range(shared):
+                page = int(e.pages[i])
+                self.pages.incref(page)
+                self.tables[slot, i] = page
+            self.table_len[slot] = shared
+            copied = 0
+            if tail:
+                # The entry always covers the boundary page: its token
+                # coverage is a page multiple >= any match depth n.
+                dst = self._map_page(slot)
+                self.cache = self._copy_page()(
+                    self.cache, jnp.int32(int(e.pages[shared])),
+                    jnp.int32(dst), jnp.int32(tail),
+                )
+                self.page_copies += 1
+                copied = tail
+            self.prefix.touch(entry_id)
+            self.prefix.acquire(entry_id)
+            return copied
         self.cache = self._copy_fn(into_cache=True)(
             self.cache, self.pool,
             jnp.int32(e.slot), jnp.int32(slot), jnp.int32(n),
         )
         self.prefix.touch(entry_id)
         self.prefix.acquire(entry_id)
+        return n
 
     def prefix_release(self, entry_id: int) -> None:
         self.prefix.release(entry_id)
 
     def prefix_store(self, prompt, slot: int) -> bool:
-        """REGISTRATION: index ``prompt`` and snapshot its freshly
-        prefilled rows ``0..p-1`` from decode ``slot`` into the claimed
-        pool slot. Must run before the slot's first decode write (the
-        scheduler does — row ``p`` is still stale here). False = pool
-        full of pinned entries, registration skipped."""
+        """REGISTRATION: index ``prompt`` and make its freshly prefilled
+        rows ``0..p-1`` resident for future hits. Must run before the
+        slot's first decode write (the scheduler does — row ``p`` is
+        still stale here). False = registration skipped (index full of
+        pinned entries, or — paged — the prompt spans no full page).
+
+        Contiguous mode snapshots the rows into a claimed pool slot (one
+        donated copy program). Paged mode DONATES instead of
+        snapshotting: the entry takes a reference on each of the slot's
+        FULL prompt pages (the partial last page stays slot-private —
+        decode is about to write into it), so registration moves zero
+        K/V bytes and the pages are shared from that moment on. The
+        slot's own reference keeps every donated page live until it
+        finishes, so an eviction racing this insert can never free
+        them."""
         prompt = np.asarray(prompt, np.int32)
+        if self.paged:
+            full = int(prompt.shape[0]) // self.page_size
+            if full < 1:
+                return False
+            pages = [int(p) for p in self.tables[slot, :full]]
+            got = self.prefix.insert(
+                prompt[: full * self.page_size], pages=pages
+            )
+            if got is None:
+                return False
+            for page in pages:
+                self.pages.incref(page)
+            return True
         got = self.prefix.insert(prompt)
         if got is None:
             return False
@@ -467,7 +873,8 @@ class InferenceEngine:
             b *= 2
         return min(b, self.config.capacity)
 
-    def prefill(self, prompt, *, slot: int, request_id: int, base: int = 0):
+    def prefill(self, prompt, *, slot: int, request_id: int, base: int = 0,
+                _bucket: int | None = None):
         """Prefill one prompt BLOCK into ``slot``: writes rows
         ``base..base+t-1`` (positions likewise), samples sequence
         element ``base + t``. ``base == 0`` with the whole prompt is
@@ -475,7 +882,11 @@ class InferenceEngine:
         copy or an earlier chunk — the sampled token is only meaningful
         when the block ends at the prompt's last token. Returns
         ``(next_token int, logits np [t, vocab])`` — the logits of
-        every position in the block, for parity pinning and scoring."""
+        every position in the block, for parity pinning and scoring.
+        ``_bucket`` forces a larger bucket than ``t`` needs — the
+        warmup ladder's compile trigger, so compiling a big bucket
+        costs one real row (and, paged, one page) instead of a full
+        bucket of writes."""
         prompt = np.asarray(prompt, np.int32)
         t = int(prompt.shape[0])
         if base < 0 or base + t > self.config.capacity:
@@ -483,9 +894,19 @@ class InferenceEngine:
                 f"prefill block [base={base}, base+{t}) outside cache "
                 f"capacity {self.config.capacity}"
             )
-        bucket = self.prefill_bucket(t)
+        bucket = self.prefill_bucket(t) if _bucket is None else _bucket
+        assert bucket >= t, (bucket, t)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :t] = prompt
+        if self.paged:
+            self._ensure_rows(slot, base + t)
+            nxt, logits, self.cache = self._prefill_paged_fn(bucket)(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.int32(t), jnp.int32(base),
+                jnp.asarray(self.tables[slot:slot + 1]),
+                jnp.int32(request_id),
+            )
+            return int(nxt), np.asarray(logits)[:t]
         nxt, logits, self.cache = self._prefill_fn(bucket)(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.int32(t), jnp.int32(base), jnp.int32(slot),
@@ -493,10 +914,40 @@ class InferenceEngine:
         )
         return int(nxt), np.asarray(logits)[:t]
 
-    def decode(self, last_tokens, lengths, request_ids, active):
+    def decode(self, last_tokens, lengths, request_ids, active, *,
+               _pages: int | None = None):
         """One batched decode step over all slots. Host arrays in,
         ``(next_tokens np [S], logits np [S, vocab])`` out; the fetch is
-        the step's true barrier (latency timing hangs off it)."""
+        the step's true barrier (latency timing hangs off it).
+
+        Paged mode first maps any page a growing slot is about to cross
+        into (consuming its admission reservation — this can never find
+        the pool empty), then runs the program whose PAGE-COUNT bucket
+        covers the widest ACTIVE table: attend cost tracks residency.
+        A mid-prefill slot's wider table truncates harmlessly — it is
+        inactive, so its writes drop and its outputs are discarded.
+        ``_pages`` forces a bucket (warmup's compile trigger, called
+        with every slot inactive so no state moves)."""
+        if self.paged:
+            lengths_np = np.asarray(lengths, np.int32)
+            active_np = np.asarray(active, bool)
+            if _pages is None:
+                widest = 1
+                for s in np.nonzero(active_np)[0]:
+                    self._ensure_rows(int(s), int(lengths_np[s]) + 1)
+                    widest = max(widest, int(self.table_len[s]))
+                pb = self.decode_page_bucket(widest)
+            else:
+                pb = _pages
+            nxt, logits, self.cache = self._decode_paged(pb)(
+                self.params, self.cache,
+                jnp.asarray(np.asarray(last_tokens, np.int32)),
+                jnp.asarray(lengths_np),
+                jnp.asarray(np.asarray(request_ids, np.int32)),
+                jnp.asarray(active_np),
+                jnp.asarray(self.tables[:, :pb]),
+            )
+            return np.asarray(nxt), np.asarray(logits)
         nxt, logits, self.cache = self._decode()(
             self.params, self.cache,
             jnp.asarray(np.asarray(last_tokens, np.int32)),

@@ -6,8 +6,10 @@ prompt prefixes (system prompts, few-shot headers, a family of requests
 sharing a long context) are recomputed per request unless their K/V rows
 are retained and reused. This module is the HOST half only — which
 prefixes are resident, where, and who may evict them; the device half is
-``serve.cache.copy_slot_prefix`` (slot-to-slot row copies), wired
-together by ``serve.engine``.
+``serve.cache.copy_slot_prefix`` (slot-to-slot row copies, contiguous
+mode) or nothing at all (paged mode: entries own refcounted PAGE lists
+donated by the registering slot, and a hit maps them into the new
+slot's block table — zero-copy), wired together by ``serve.engine``.
 
 Design decisions:
 
@@ -48,19 +50,28 @@ class _Node:
 
 @dataclasses.dataclass
 class Entry:
-    """One resident prefix: ``tokens`` rows live in pool slot ``slot``."""
+    """One resident prefix. Contiguous mode: ``tokens`` rows live in
+    prefix-pool slot ``slot``. Paged mode (``slot == -1``): the entry
+    OWNS a reference on each page in ``pages`` — the rows were donated
+    by the registering slot's table, never copied, and a hit maps them
+    straight into the new slot's table (``serve.engine``)."""
 
     id: int
     tokens: tuple[int, ...]
     slot: int
     refs: int = 0
     last_used: int = 0
+    pages: tuple[int, ...] = ()
 
 
 class PrefixIndex:
-    """Trie + pool bookkeeping for ``slots`` resident prefixes."""
+    """Trie + pool bookkeeping for up to ``slots`` resident prefixes
+    (pool slots in contiguous mode; plain entry count in paged mode).
+    ``on_evict`` (paged mode) is called with each evicted :class:`Entry`
+    so the engine can drop the entry's page references — eviction is the
+    ONLY place entries give pages back."""
 
-    def __init__(self, slots: int):
+    def __init__(self, slots: int, on_evict=None):
         if slots < 1:
             raise ValueError(f"prefix pool needs >= 1 slot, got {slots}")
         self.slots = slots
@@ -69,6 +80,7 @@ class PrefixIndex:
         self._free = list(range(slots - 1, -1, -1))  # pop() yields slot 0 first
         self._next_id = 0
         self._clock = 0
+        self._on_evict = on_evict
         self.insertions = 0
         self.evictions = 0
         self.skipped_full = 0
@@ -122,31 +134,37 @@ class PrefixIndex:
 
     # -- registration / eviction -------------------------------------------
 
-    def insert(self, tokens) -> tuple[int, int] | None:
-        """Claim a pool slot for ``tokens``: ``(entry_id, pool_slot)``,
+    def insert(self, tokens, *, pages=None) -> tuple[int, int] | None:
+        """Claim residency for ``tokens``: ``(entry_id, pool_slot)``,
         evicting the least-recently-used ZERO-REF entry if the pool is
         full, or ``None`` (registration skipped) when every resident
-        entry is pinned by a live reader. The caller performs the device
-        copy into the returned slot."""
-        if self._free:
-            slot = self._free.pop()
-        else:
-            victim = min(
-                (e for e in self._entries.values() if e.refs == 0),
-                key=lambda e: e.last_used,
-                default=None,
-            )
-            if victim is None:
+        entry is pinned by a live reader.
+
+        Contiguous mode (``pages is None``): claims a pool slot; the
+        caller performs the device copy into it. Paged mode: the entry
+        records ``pages`` (the registering slot's table prefix — the
+        caller increfs them; no device work) and the returned slot is
+        ``-1``. Eviction in paged mode hands the victim to ``on_evict``
+        so its page references drop."""
+        paged = pages is not None
+        if paged:
+            if len(self._entries) >= self.slots and self._evict_lru() is None:
                 self.skipped_full += 1
                 return None
-            self._remove(victim)
-            self.evictions += 1
+            slot = -1
+        elif self._free:
+            slot = self._free.pop()
+        else:
+            if self._evict_lru() is None:
+                self.skipped_full += 1
+                return None
             slot = self._free.pop()
         eid = self._next_id
         self._next_id += 1
         self._entries[eid] = Entry(
             id=eid, tokens=tuple(int(t) for t in tokens), slot=slot,
             last_used=self._tick(),
+            pages=tuple(int(p) for p in pages) if paged else (),
         )
         node = self._root
         for tok in self._entries[eid].tokens:
@@ -154,6 +172,33 @@ class PrefixIndex:
             node.holders.add(eid)
         self.insertions += 1
         return eid, slot
+
+    def _evict_lru(self, want=None) -> Entry | None:
+        """Evict the least-recently-used ZERO-REF entry satisfying
+        ``want`` (``None`` when no such entry exists), notifying
+        ``on_evict``."""
+        victim = min(
+            (e for e in self._entries.values()
+             if e.refs == 0 and (want is None or want(e))),
+            key=lambda e: e.last_used,
+            default=None,
+        )
+        if victim is None:
+            return None
+        self._remove(victim)
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(victim)
+        return victim
+
+    def evict_lru(self, want=None) -> Entry | None:
+        """Public reclaim hook (paged mode): the scheduler evicts
+        zero-ref entries to free shared pages when admission runs short
+        (``serve.engine.reclaim_pages``). ``want`` filters candidates —
+        the engine passes "would actually free a page", so reclaim
+        never wipes entries whose pages live slots still hold (evicting
+        those frees nothing now and only costs future hits)."""
+        return self._evict_lru(want)
 
     def _remove(self, e: Entry) -> None:
         path = [self._root]
@@ -169,4 +214,5 @@ class PrefixIndex:
             if not node.children and not node.holders:
                 del parent.children[tok]
         del self._entries[e.id]
-        self._free.append(e.slot)
+        if e.slot >= 0:  # paged entries (slot == -1) hold pages, not slots
+            self._free.append(e.slot)

@@ -28,6 +28,19 @@ Two admission optimizations ride on the engine's offset prefill
   tail (``ServeStats.itl``) is the metric it bounds. A slot being
   chunk-prefilled is occupied but not yet decoding.
 
+**Paged admission** (ISSUE 7): when the engine runs the paged KV pool
+(``ServeConfig.page_size``), admission becomes "enough free pages" —
+the scheduler reserves ``ceil((prompt + max_new) / page_size)`` pages
+(minus the full pages a prefix hit shares) before claiming a slot, so
+capacity pools ACROSS requests instead of reserving a worst-case ring
+per slot. When the queue head cannot fit, it WAITS (strict FIFO — no
+head-of-line bypass, so runs stay deterministic) after first asking the
+engine to reclaim pages from zero-ref prefix entries. Completion and
+deadline eviction release pages identically (``engine.release_slot``).
+Per-tick gauges ``serve_kv_pages_free`` / ``serve_kv_pages_shared`` and
+the ``kv_pages_held`` attribute on ``complete`` events surface the pool
+story through the PR 5 registry/trace surfaces.
+
 The scheduler is deliberately pure Python — policy lives here (arrival
 order, slot choice, stop conditions, prefix/chunk policy), device work
 lives in the jitted engine. Determinism contract: sampling keys depend
@@ -195,6 +208,13 @@ class Scheduler:
                  shed_threshold: int | None = None, injector=None):
         self.engine = engine
         self.eos_id = eos_id
+        if allow_window and engine.paged:
+            raise ValueError(
+                "allow_window is a ring-buffer (contiguous) semantics — "
+                "the paged layout never wraps (a wrap would stomp shared "
+                "prefix pages); size capacity/num_pages for the full "
+                "request instead"
+            )
         self.allow_window = allow_window
         # Resilience config (ISSUE 6), validated at CONSTRUCTION in
         # _validate's submit-time style — a bad value is a loud error
@@ -275,23 +295,74 @@ class Scheduler:
             (self.tracer, self.registry, self.metrics_writer,
              self.ttft_deadline_s, self.deadline_s,
              self.shed_threshold, self.injector) = saved
+        if eng.paged:
+            # The clone run may leave prefix entries holding pages; the
+            # compile ladders below need a clean pool (a tight pool
+            # could otherwise exhaust mid-warmup). Warmup discards all
+            # engine state at the end regardless.
+            eng.reset()
         max_bucket = eng.prefill_bucket(max(
             int(np.asarray(r.prompt).shape[0]) for r in requests
         ))
         b = 8
         while True:
             # min() also covers a capacity-capped (non-power-of-two)
-            # top bucket the doubling ladder would step over.
+            # top bucket the doubling ladder would step over. The
+            # 1-token prompt at a FORCED bucket compiles the program
+            # with one real row — so the paged ladder costs one page,
+            # not a worst-case table's worth.
             bucket = min(b, max_bucket)
-            eng.prefill(np.zeros(bucket, np.int32),
-                        slot=0, request_id=-1, base=0)
+            eng.prefill(np.zeros(1, np.int32), slot=0, request_id=-1,
+                        base=0, _bucket=bucket)
             if bucket == max_bucket:
                 break
             b *= 2
+        if eng.paged:
+            eng.release_slot(0)
+            # Decode is keyed by PAGE-COUNT bucket: compile the ladder
+            # up to the widest residency the real run can reach (the
+            # truncated clones never grow past ~2 generated tokens, so
+            # the big buckets would otherwise jit inside a timed
+            # bracket). All-inactive batches compile without moving
+            # state: every write maps out of bounds and drops.
+            top = eng.decode_page_bucket(eng.pages_needed(max(
+                min(int(np.asarray(r.prompt).shape[0]) + r.max_new_tokens,
+                    eng.config.capacity)
+                for r in requests
+            )))
+            S = eng.config.slots
+            zeros = np.zeros(S, np.int32)
+            pb = 1
+            while True:
+                pbi = min(pb, eng.max_pages)
+                eng.decode(zeros, zeros, zeros, np.zeros(S, bool),
+                           _pages=pbi)
+                if pbi >= top:
+                    break
+                pb *= 2
         if eng.prefix is not None:
-            # One store + fetch compiles both copy programs even when
-            # the truncated clone run happened to produce no hit.
-            if eng.prefix_store(np.zeros(2, np.int32), 0):
+            if eng.paged:
+                # The paged hit path moves no K/V rows EXCEPT the CoW
+                # partial-tail-page copy — seed two full pages, register
+                # (zero-copy donation), and take one page-UNALIGNED hit
+                # so that one program compiles here, not mid-run. Tiny
+                # pools (< 3 pages of headroom) skip — such a run
+                # compiles it lazily on its first unaligned hit.
+                ps = eng.page_size
+                if eng.max_pages >= 2 and eng.num_pages >= 3:
+                    eng.prefill(np.zeros(2 * ps, np.int32), slot=0,
+                                request_id=-1, base=0)
+                    if eng.prefix_store(np.zeros(2 * ps, np.int32), 0):
+                        entry, _ = eng.prefix.match(
+                            np.zeros(2 * ps, np.int32)
+                        )
+                        eng.release_slot(0)
+                        eng.prefix_fetch(entry, ps + 1, 0)
+                        eng.prefix_release(entry)
+            # One store + fetch compiles both contiguous copy programs
+            # even when the truncated clone run happened to produce no
+            # hit.
+            elif eng.prefix_store(np.zeros(2, np.int32), 0):
                 entry, _ = eng.prefix.match(np.zeros(2, np.int32))
                 eng.prefix_fetch(entry, 2, 0)
                 eng.prefix_release(entry)
@@ -321,13 +392,35 @@ class Scheduler:
         if p + r.max_new_tokens > cap and not self.allow_window:
             # Without the check the ring would silently wrap into
             # sliding-window attention mid-generation — a semantics
-            # change, not an error, so it is opt-in only.
+            # change, not an error, so it is opt-in only. On the paged
+            # layout this bound is the block-TABLE REACH (max_pages
+            # pages) and there is no window escape hatch (pages never
+            # wrap) — same loud submit-time rejection, naming the fix.
+            if self.engine.paged:
+                raise ValueError(
+                    f"request {r.id}: prompt ({p}) + max_new_tokens "
+                    f"({r.max_new_tokens}) exceeds the block-table reach "
+                    f"({self.engine.max_pages} pages x "
+                    f"{self.engine.page_size} rows = {cap}); raise "
+                    "--capacity (table width) or shorten the request"
+                )
             raise ValueError(
                 f"request {r.id}: prompt ({p}) + max_new_tokens "
                 f"({r.max_new_tokens}) exceeds cache capacity {cap} "
                 f"(pass allow_window=True to accept sliding-window "
                 f"attention once the ring wraps)"
             )
+        if self.engine.paged:
+            need = self.engine.pages_needed(p + r.max_new_tokens)
+            if need > self.engine.num_pages:
+                # The whole-pool bound: even an otherwise-empty engine
+                # could never hold this request's worst case.
+                raise ValueError(
+                    f"request {r.id}: prompt ({p}) + max_new_tokens "
+                    f"({r.max_new_tokens}) needs {need} KV pages but the "
+                    f"pool holds num_pages={self.engine.num_pages}; "
+                    "raise --num-pages or shorten the request"
+                )
         for name, v in (("ttft_deadline_s", r.ttft_deadline_s),
                         ("deadline_s", r.deadline_s)):
             if v is not None and v <= 0:
@@ -402,12 +495,16 @@ class Scheduler:
             # An exception mid-run (device failure, KeyboardInterrupt)
             # must not leave pool entries pinned forever on an engine
             # that outlives this run — orphaned refs would block every
-            # future eviction AND registration. Normal completion has
-            # already released everything (finish()), so this no-ops.
+            # future eviction AND registration, and (paged) leaked page
+            # references would shrink the pool for every future run.
+            # Normal completion has already released everything
+            # (finish()), so this no-ops.
             for s in range(S):
                 if held_entry[s] >= 0:
                     eng.prefix_release(held_entry[s])
                     held_entry[s] = -1
+                if eng.paged and occupant[s] is not None:
+                    eng.release_slot(s)
 
     def _drive(self, requests, pending, occupant, active, lengths,
                last_tokens, req_ids, generated, admitted_at, prefilled,
@@ -449,6 +546,14 @@ class Scheduler:
             )
             active[s] = False
             occupant[s] = None
+            pages_held = int(eng.table_len[s]) if eng.paged else 0
+            if eng.paged:
+                # Page references drop (shared prefix pages survive on
+                # their entry's reference) and any unused reservation
+                # returns — eviction and completion are the same
+                # bookkeeping, so a deadline eviction can never leak
+                # pool capacity.
+                eng.release_slot(s)
             if held_entry[s] >= 0:
                 # Deadline eviction releases pinned prefix refs exactly
                 # like normal completion — an evicted request can never
@@ -457,8 +562,12 @@ class Scheduler:
                 held_entry[s] = -1
             if tr:
                 # Completion IS the eviction: the slot frees here.
+                # kv_pages_held records the request's peak residency at
+                # completion (ISSUE 7 satellite — 0 on the contiguous
+                # layout, where residency is the fixed capacity).
                 tr.event("complete", req=int(r.id), slot=s, step=step,
-                         tokens=len(generated[s]), status=status)
+                         tokens=len(generated[s]), status=status,
+                         kv_pages_held=pages_held)
             if reg is not None:
                 if status == "deadline_exceeded":
                     reg.counter("serve_deadline_exceeded_total").inc()
@@ -551,13 +660,52 @@ class Scheduler:
                         finish(s, status="deadline_exceeded")
             # Admit: claim every free slot whose turn has come. With the
             # prefix cache, admission itself is only the (optional) row
-            # copy — prompt compute happens in the prefill phase below.
+            # copy (contiguous) or table mapping (paged) — prompt
+            # compute happens in the prefill phase below. On the paged
+            # pool, admission FIRST checks "enough free pages" for the
+            # request's worst case (prompt + max_new, minus the full
+            # pages a prefix hit shares) and RESERVES them — capacity
+            # pools across slots instead of a per-slot worst-case ring.
+            # The queue stays strictly FIFO: when the head cannot fit,
+            # nothing behind it admits either (deterministic, and no
+            # small-request starvation of the long head).
             for s in range(S):
                 if occupant[s] is not None or not pending \
                         or pending[0].arrival > step:
                     continue
-                r = pending.popleft()
+                r = pending[0]
                 p = int(np.asarray(r.prompt).shape[0])
+
+                def probe():
+                    # The match is PURE (no LRU stamp), so probing before
+                    # admission is decided cannot perturb the index.
+                    if eng.prefix is None:
+                        return -1, 0, 0
+                    entry, full = eng.prefix.match(r.prompt)
+                    hit = min(full, p - 1)
+                    return entry, full, hit if hit >= MIN_PREFIX_HIT else 0
+
+                entry, full, hit = probe()
+                if eng.paged:
+                    while True:
+                        need = eng.pages_needed(p + r.max_new_tokens) \
+                            - hit // eng.page_size
+                        if eng.pages.available >= need:
+                            break
+                        if not eng.reclaim_pages(need):
+                            need = -1
+                            break
+                        # Reclaim may have evicted the matched entry
+                        # itself (it was zero-ref) — re-probe so the
+                        # fetch below can never reference a ghost and
+                        # the reservation covers the (possibly shrunk)
+                        # hit. Entries strictly decrease per round, so
+                        # this terminates.
+                        entry, full, hit = probe()
+                    if need < 0:
+                        break  # head waits for pages; FIFO holds
+                    eng.reserve_pages(s, need)
+                pending.popleft()
                 occupant[s] = r
                 generated[s] = []
                 admitted_at[s] = step
@@ -567,15 +715,22 @@ class Scheduler:
                     tr.event("admit", req=int(r.id), slot=s, step=step)
                 if eng.prefix is not None:
                     lookups += 1
-                    entry, full = eng.prefix.match(r.prompt)
-                    hit = min(full, p - 1)
                     if hit >= MIN_PREFIX_HIT:
                         t0 = time.perf_counter() if tr else 0.0
-                        eng.prefix_fetch(entry, hit, s)
+                        copied = eng.prefix_fetch(entry, hit, s)
                         if tr:
-                            tr.complete("prefix_copy", t0,
-                                        time.perf_counter(),
-                                        req=int(r.id), slot=s, rows=hit)
+                            # Contiguous: a pool->slot row gather of all
+                            # `hit` rows. Paged: zero-copy page mapping;
+                            # copied_rows is the CoW partial tail page
+                            # only (< page_size — the zero-copy pin
+                            # asserts on exactly this attribute).
+                            tr.complete(
+                                "prefix_map" if eng.paged
+                                else "prefix_copy",
+                                t0, time.perf_counter(),
+                                req=int(r.id), slot=s, rows=hit,
+                                copied_rows=int(copied),
+                            )
                         held_entry[s] = entry
                         base = hit
                         hits += 1
@@ -727,6 +882,14 @@ class Scheduler:
                 if eng.prefix is not None:
                     reg.gauge("serve_prefix_pool_entries").set(
                         len(eng.prefix)
+                    )
+                if eng.paged:
+                    # Pool utilization (ISSUE 7 satellite): free pages
+                    # are the admission headroom, shared pages (ref >=
+                    # 2) the zero-copy prefix win made visible.
+                    reg.gauge("serve_kv_pages_free").set(eng.pages.free)
+                    reg.gauge("serve_kv_pages_shared").set(
+                        eng.pages.shared
                     )
                 if self.metrics_writer is not None:
                     # Rate-limited internally (interval_s): the per-tick

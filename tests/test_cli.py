@@ -252,6 +252,31 @@ def test_main_serve_prefix_cache_and_chunked_prefill(capsys):
                for c in payload["completions"].values())
 
 
+def test_main_serve_paged_pool_end_to_end(capsys):
+    """ISSUE 7 CLI surface: ``--page-size``/``--num-pages`` serve the
+    same workload on the paged pool (prefix sharing + chunking on — the
+    full composition), with the JSON contract carrying the page fields
+    and the warmup having compiled the page-count ladders (any jit
+    inside the run would still pass, but the run exercises the paged
+    warmup path end to end)."""
+    assert main([
+        "serve", "--slots", "2", "--capacity", "64", "--max-new-tokens",
+        "4", "--num-prompts", "3", "--prompt-min", "6", "--prompt-max",
+        "12", "--vocab", "16", "--d-model", "32", "--heads", "2",
+        "--layers", "2", "--d-ff", "64", "--prefix-cache", "2",
+        "--prefill-chunk", "8", "--page-size", "8", "--num-pages", "12",
+        "--json",
+    ]) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["variant"] == "serve"
+    assert payload["config"]["page_size"] == 8
+    assert payload["config"]["num_pages"] == 12
+    assert payload["kv_pages_free"] >= 0
+    assert len(payload["completions"]) == 3
+    assert all(c["status"] == "ok" and len(c["tokens"]) == 4
+               for c in payload["completions"].values())
+
+
 def test_main_serve_rejects_bad_prefix_chunk_flags():
     """Flag hygiene both ways: serve-only prefix/chunk flags fail
     loudly on training variants, and invalid combinations fail as
@@ -264,3 +289,12 @@ def test_main_serve_rejects_bad_prefix_chunk_flags():
         main(["serve", "--platform", "cpu", "--prefill-chunk", "12"])
     with pytest.raises(SystemExit, match="serve config error"):
         main(["serve", "--platform", "cpu", "--prefill-budget", "16"])
+    # Paged flag hygiene (ISSUE 7), both directions: geometry errors
+    # are loud config errors; --num-pages without --page-size too.
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--page-size", "12"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--num-pages", "8"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--page-size", "8",
+              "--num-pages", "2"])  # below --slots (default 4)

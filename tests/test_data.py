@@ -119,6 +119,55 @@ def test_shared_prefix_prompts_bounds_and_validation():
         synthesize_shared_prefix_prompts(vocab=1)
 
 
+def test_longtail_prompts_structure_and_validation():
+    """ISSUE 7 satellite: the long-tail mix generator is
+    seed-deterministic, returns num_short + num_long prompts with the
+    longs EXACTLY long_len tokens, spread through the shorts (never a
+    head-of-line burst), sharing their long_prefix_len prefix; BOS-led
+    int32 payloads in [1, vocab); malformed configs fail fast."""
+    from ddl_tpu.data.lm import synthesize_longtail_prompts
+
+    kw = dict(num_short=10, num_long=2, short_min=4, short_max=12,
+              long_len=48, vocab=32)
+    a = synthesize_longtail_prompts(seed=3, **kw)
+    b = synthesize_longtail_prompts(seed=3, **kw)
+    c = synthesize_longtail_prompts(seed=4, **kw)
+    assert len(a) == 12
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+    longs = [p for p in a if len(p) == 48]
+    shorts = [p for p in a if len(p) != 48]
+    assert len(longs) == 2 and len(shorts) == 10
+    assert all(4 <= len(p) <= 12 for p in shorts)
+    # Longs share the default long_len // 2 prefix but diverge after.
+    np.testing.assert_array_equal(longs[0][:24], longs[1][:24])
+    assert not np.array_equal(longs[0][24:], longs[1][24:])
+    # Longs are spread, not front-loaded: neither occupies the head.
+    long_positions = [i for i, p in enumerate(a) if len(p) == 48]
+    assert long_positions[0] > 0 and long_positions[1] > long_positions[0] + 1
+    for p in a:
+        assert p.dtype == np.int32 and p[0] == 0
+        assert (p[1:] >= 1).all() and (p[1:] < 32).all()
+    # A shorts-only or longs-only mix is legal — and shorts-only must
+    # not touch (or choke on) long parameters at all.
+    assert len(synthesize_longtail_prompts(num_short=3, num_long=0)) == 3
+    assert len(synthesize_longtail_prompts(num_short=3, num_long=0,
+                                           long_len=1)) == 3
+    only_long = synthesize_longtail_prompts(num_short=0, num_long=2,
+                                            long_len=32)
+    assert all(len(p) == 32 for p in only_long)
+    with pytest.raises(ValueError, match="at least one"):
+        synthesize_longtail_prompts(num_short=0, num_long=0)
+    with pytest.raises(ValueError, match="short_min"):
+        synthesize_longtail_prompts(short_min=8, short_max=4)
+    with pytest.raises(ValueError, match="long_len"):
+        synthesize_longtail_prompts(long_len=10, short_max=12)
+    with pytest.raises(ValueError, match="long_prefix_len"):
+        synthesize_longtail_prompts(long_len=48, long_prefix_len=99)
+    with pytest.raises(ValueError, match="vocab"):
+        synthesize_longtail_prompts(vocab=1)
+
+
 def test_one_hot_matches_get_dummies_semantics():
     y = np.array([3, 0, 9, 3])
     oh = one_hot(y)

@@ -15,7 +15,9 @@ Bounds (per test function, per run):
 - **> 64 total generated tokens** — estimated statically as
   ``requests_per_run * max_new_tokens``, where ``requests_per_run`` is
   the larger of the prompt-set size (literal ``num=`` /
-  ``n_families * per_family`` of a ``synthesize_*prompts`` call) and
+  ``n_families * per_family`` / ``num_short + num_long`` of a
+  ``synthesize_*prompts`` call — the long-tail generator of the paged
+  serve tests included) and
   the count of ``Request(...)`` constructor sites, and
   ``max_new_tokens`` is the largest resolvable int literal passed under
   that keyword. Code inside ``pytest.raises`` blocks is excluded (a
@@ -40,7 +42,8 @@ import textwrap
 
 MAX_FAST_TOKENS = 64
 MAX_FAST_TOPOLOGIES = 2
-_PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts")
+_PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts",
+                   "synthesize_longtail_prompts")
 _ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
 
 
@@ -133,6 +136,10 @@ def estimate(fn) -> tuple[bool, int, int]:
             fam = _kw_int(node, "n_families") or 1
             per = _kw_int(node, "per_family") or 1
             prompt_set = max(prompt_set, fam * per)
+        elif name == "synthesize_longtail_prompts":
+            ns = _kw_int(node, "num_short") or 0
+            nl = _kw_int(node, "num_long") or 0
+            prompt_set = max(prompt_set, ns + nl)
     tokens = max(prompt_set, request_sites) * max_new
     return uses_scheduler, tokens, topologies
 
@@ -358,6 +365,13 @@ def test_audit_estimator_flags_and_permits():
                     for i, p in enumerate(ps)]
             Scheduler(InferenceEngine(ServeConfig())).run(reqs)
 
+        def test_longtail_overrun():
+            ps = synthesize_longtail_prompts(num_short=10, num_long=2,
+                                             long_len=96)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=8)
+                    for i, p in enumerate(ps)]
+            Scheduler(InferenceEngine(ServeConfig(page_size=8))).run(reqs)
+
         def test_rejected_requests_exempt():
             sched = Scheduler(InferenceEngine(ServeConfig()))
             with pytest.raises(ValueError):
@@ -370,12 +384,17 @@ def test_audit_estimator_flags_and_permits():
     """)
     tree = ast.parse(src)
     names = {v[0] for v in _audit(tree)}
-    assert names == {"test_token_overrun", "test_topology_sweep"}
+    assert names == {"test_token_overrun", "test_topology_sweep",
+                     "test_longtail_overrun"}
     fns = {f.name: f for f in tree.body
            if isinstance(f, ast.FunctionDef)}
     assert has_slow_marker(fns["test_marked_overrun"])
     uses, tokens, topo = estimate(fns["test_token_overrun"])
     assert uses and tokens == 200 and topo == 1
+    # The paged-serve long-tail generator counts num_short + num_long —
+    # the ISSUE 7 audit extension, pinned so it cannot rot.
+    uses, tokens, topo = estimate(fns["test_longtail_overrun"])
+    assert uses and tokens == 96 and topo == 1
     _, tokens, topo = estimate(fns["test_topology_sweep"])
     assert tokens == 1 and topo == 3
     _, tokens, _ = estimate(fns["test_in_budget"])

@@ -392,6 +392,70 @@ def test_kv_copy_prefix_op():
     np.testing.assert_array_equal(out[:, 2:], 0.0)
 
 
+def test_kv_copy_prefix_edge_n_zero_and_full_capacity():
+    """ISSUE 7 satellite: the copy-range boundaries, exercised directly
+    (previously only reached through the engine). n=0 copies NOTHING
+    (dst bit-unchanged — an empty hit is a no-op by construction);
+    n=capacity copies EVERYTHING (dst == src bitwise — a full-cache hit
+    leaves no seam); both ends also hold for the traced-scalar form the
+    compiled copy programs use."""
+    key = jax.random.PRNGKey(20)
+    src = jax.random.normal(key, (1, 1, 6, 2, 8))
+    dst = jax.random.normal(jax.random.fold_in(key, 1), (1, 1, 6, 2, 8))
+    out0 = np.asarray(kv_cache.copy_prefix(dst, src, jnp.int32(0), axis=2))
+    np.testing.assert_array_equal(out0, np.asarray(dst))
+    out_full = np.asarray(
+        kv_cache.copy_prefix(dst, src, jnp.int32(6), axis=2)
+    )
+    np.testing.assert_array_equal(out_full, np.asarray(src))
+    # Same answers under jit with a TRACED n — the compiled-program
+    # form (one program covers every hit length, 0 and capacity
+    # included).
+    jitted = jax.jit(lambda d, s, n: kv_cache.copy_prefix(d, s, n, axis=2))
+    np.testing.assert_array_equal(
+        np.asarray(jitted(dst, src, jnp.int32(0))), np.asarray(dst)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jitted(dst, src, jnp.int32(6))), np.asarray(src)
+    )
+    # n beyond the axis saturates at "everything" (mask arange < n).
+    np.testing.assert_array_equal(
+        np.asarray(jitted(dst, src, jnp.int32(99))), np.asarray(src)
+    )
+
+
+def test_kv_attend_all_pad_rows_is_finite_and_length_stable():
+    """ISSUE 7 satellite: attend over a cache of ONLY PAD_POS rows (a
+    fresh slot / fresh page pool) stays FINITE — the all-masked softmax
+    degrades to uniform weights over junk it then multiplies by exactly
+    representable values, never NaN/Inf — and adding more masked
+    padding never changes a valid query's output BITWISE (masked rows
+    contribute exactly 0), which is the property the paged page-count
+    buckets stand on (ops.kv_cache.gather_pages and the paged ≡
+    contiguous pin in tests/test_serve_paged.py)."""
+    key = jax.random.PRNGKey(21)
+    q = jax.random.normal(key, (2, 3, 2, 8))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 16, 2, 8))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 16, 2, 8))
+    qpos = jnp.broadcast_to(jnp.arange(3), (2, 3))
+    # All-PAD cache: nothing attendable, output must still be finite
+    # (free slots and freshly admitted paged slots ride decode exactly
+    # like this).
+    all_pad = jnp.full((2, 16), PAD_POS)
+    out = np.asarray(kv_cache.attend(q, k, v, qpos, all_pad))
+    assert np.isfinite(out).all()
+    # Length stability: valid rows + masked tail of DIFFERENT lengths
+    # produce bitwise-identical outputs (the page-count bucket ladder's
+    # correctness condition).
+    kpos8 = jnp.where(jnp.arange(8) < 3, jnp.arange(8), PAD_POS)[None]
+    kpos16 = jnp.where(jnp.arange(16) < 3, jnp.arange(16), PAD_POS)[None]
+    a8 = np.asarray(kv_cache.attend(q[:1], k[:1, :8], v[:1, :8],
+                                    qpos[:1], kpos8))
+    a16 = np.asarray(kv_cache.attend(q[:1], k[:1], v[:1],
+                                     qpos[:1], kpos16))
+    np.testing.assert_array_equal(a8, a16)
+
+
 @pytest.mark.parametrize("chunk", [8, 16])
 def test_chunked_prefill_logits_exactly_equal_one_shot(chunk):
     """Acceptance pin: prefilling a prompt in fixed chunks (base

@@ -1,0 +1,427 @@
+"""Paged KV cache (ISSUE 7): block-table attention, zero-copy
+refcounted prefix sharing, pooled serve capacity.
+
+The oracle chain: the CONTIGUOUS slot-major engine (PR 2-6, retained
+behind ``page_size=0``) is the bit-exactness reference — the paged
+engine must reproduce its tokens AND per-step logits bitwise through
+the whole serving stack (staggered arrivals, prefix sharing, chunked
+prefill, deadline eviction), at tp=1 and tp=2. On top of parity, the
+paged-only contracts: a prefix hit moves zero K/V rows beyond the one
+copy-on-write partial tail page (the ``page_copies`` counter and the
+``prefix_map`` trace events assert it), refcounted pages reclaim when
+their last holder finishes (pool reusable), and admission pools
+capacity across slots ("enough free pages" — a long-tail mix admits
+under a pool the slot-major layout must worst-case-reserve).
+
+Every scheduler-driving test stays inside the tier-1 audit budget
+(tests/test_markers.py: <= 64 estimated tokens, <= 2 topologies).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.data.lm import (
+    synthesize_longtail_prompts,
+    synthesize_shared_prefix_prompts,
+)
+from ddl_tpu.models.transformer import TINY_SPEC
+from ddl_tpu.obs.trace import Tracer
+from ddl_tpu.ops import kv_cache
+from ddl_tpu.ops.kv_cache import PAD_POS
+from ddl_tpu.serve import (
+    InferenceEngine,
+    Request,
+    Scheduler,
+    ServeConfig,
+)
+
+SPEC = TINY_SPEC
+
+
+# -- ops: the block-table primitives ------------------------------------------
+
+
+def test_table_rows_gather_and_write_roundtrip():
+    """The paged device contract end to end at the op level: logical
+    rows flatten through the table (unmapped/out-of-reach -> OOB, so
+    writes DROP), gathers return pages in logical order, and positions
+    travel with rows (PAD_POS where the table is unmapped)."""
+    ps, P = 4, 6
+    pool = jnp.zeros((P, ps, 3))
+    pos = jnp.full((P, ps), PAD_POS)
+    # Slot 0 owns pages [2, 0]; slot 1 owns [5]; second entries unmapped.
+    table = jnp.asarray([[2, 0], [5, -1]], jnp.int32)
+    logical = jnp.asarray([[0, 1, 5], [2, 9, 4]], jnp.int32)
+    flat = kv_cache.table_rows(table, logical, ps, P)
+    # slot 0: rows 0,1 -> page 2 offsets 0,1 (flat 8,9); row 5 -> page 0
+    # offset 1 (flat 1). slot 1: row 2 -> page 5 offset 2 (flat 22);
+    # row 9 is beyond the 2-page reach -> drop; row 4 -> page index 1 is
+    # UNMAPPED (-1) -> drop.
+    np.testing.assert_array_equal(np.asarray(flat),
+                                  [[8, 9, 1], [22, 24, 24]])
+    new = jnp.arange(2 * 3 * 3, dtype=jnp.float32).reshape(2, 3, 3) + 1
+    out = kv_cache.write_rows_flat(pool, new, flat)
+    np.testing.assert_array_equal(np.asarray(out)[2, 0], [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(out)[2, 1], [4, 5, 6])
+    np.testing.assert_array_equal(np.asarray(out)[0, 1], [7, 8, 9])
+    np.testing.assert_array_equal(np.asarray(out)[5, 2], [10, 11, 12])
+    # Only the four mapped writes landed; the two dropped rows of slot 1
+    # left no trace anywhere in the pool.
+    assert float(jnp.abs(out).sum()) == sum(
+        float(jnp.abs(new[b, t]).sum()) for b, t in
+        [(0, 0), (0, 1), (0, 2), (1, 0)]
+    )
+    # Gather returns slot 0's pages in TABLE order: page 2 then page 0.
+    g = kv_cache.gather_pages(out, table)
+    assert g.shape == (2, 2 * ps, 3)
+    np.testing.assert_array_equal(np.asarray(g)[0, 0], [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(g)[0, ps + 1], [7, 8, 9])
+    # Positions: written rows carry their values, unmapped pages PAD.
+    pos2 = kv_cache.write_rows_flat(
+        pos, jnp.asarray([[0, 1, 5], [2, 9, 4]]), flat
+    )
+    kpos = kv_cache.table_positions(pos2, table)
+    assert int(kpos[0, 0]) == 0 and int(kpos[0, 1]) == 1
+    assert int(kpos[0, ps + 1]) == 5
+    assert int(kpos[1, 2]) == 2
+    assert (np.asarray(kpos)[1, ps:] == PAD_POS).all()  # unmapped page
+
+
+# -- validation: loud ctor + loud submit (ISSUE 7 satellite) ------------------
+
+
+def test_paged_engine_config_validation_both_directions():
+    """Bad page geometry is a CONSTRUCTION error naming the fix (the
+    PR 4/6 loud-ctor pattern): non-power-of-two page_size, num_pages
+    without page_size, num_pages below slots, capacity not tiling into
+    pages. The matching good configs construct (both directions)."""
+    good = dict(spec=SPEC, slots=2, capacity=32)
+    for bad, msg in (
+        (dict(page_size=12), "power of two"),
+        (dict(page_size=-8), "power of two"),
+        (dict(num_pages=8), "requires page_size"),
+        (dict(page_size=8, num_pages=1), "below slots"),
+        (dict(page_size=8, num_pages=-1), "num_pages"),
+        (dict(page_size=64), "multiple"),  # capacity 32 % 64 != 0
+    ):
+        with pytest.raises(ValueError, match=msg):
+            InferenceEngine(ServeConfig(**good, **bad))
+    eng = InferenceEngine(ServeConfig(**good, page_size=8, num_pages=2))
+    assert eng.paged and eng.max_pages == 4 and eng.num_pages == 2
+    # num_pages defaults to the slot-major envelope: slots * max_pages.
+    eng = InferenceEngine(ServeConfig(**good, page_size=8))
+    assert eng.num_pages == 2 * 4
+    # page_size=0 stays the contiguous oracle.
+    assert not InferenceEngine(ServeConfig(**good)).paged
+
+
+def test_paged_scheduler_submit_validation_names_request():
+    """Submit-time bounds name the offending request and the fix: the
+    block-TABLE reach (capacity) and the whole-POOL reach (num_pages);
+    allow_window has no paged semantics and is rejected at
+    construction. The same requests admit once sized correctly."""
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=32,
+                                      page_size=8, num_pages=5))
+    sched = Scheduler(eng)
+    ok = Request(id=1, prompt=np.zeros(6, np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match=r"request 9.*block-table reach"):
+        sched.run([ok, Request(id=9, prompt=np.zeros(20, np.int32),
+                               max_new_tokens=20)])
+    with pytest.raises(ValueError, match=r"request 8.*num_pages=3"):
+        # 20 + 12 = 32 rows = 4 pages: INSIDE the table reach (4 pages)
+        # but over a 3-page pool — the whole-pool bound fires, naming
+        # the pool, not the table.
+        Scheduler(InferenceEngine(ServeConfig(
+            spec=SPEC, slots=2, capacity=32, page_size=8, num_pages=3,
+        ))).run([Request(id=8, prompt=np.zeros(20, np.int32),
+                         max_new_tokens=12)])
+    with pytest.raises(ValueError, match="allow_window"):
+        Scheduler(eng, allow_window=True)
+    done, _ = sched.run([ok])
+    assert done[1].status == "ok" and len(done[1].tokens) == 2
+
+
+# -- THE acceptance pin: paged ≡ contiguous, bitwise --------------------------
+
+
+def _capture_logits(eng):
+    """Map ``(request_id, position) -> logits row`` for every logit the
+    engine computes, by wrapping its host API (the scheduler drives the
+    wrapped engine unchanged): a prefill block at ``base`` contributes
+    rows for positions ``base..base+t-1``, a decode tick one row per
+    ACTIVE slot at its current length. Position-keyed because prefix
+    hit depths may legitimately DIFFER between layouts (paged entries
+    register floor-to-page coverage), shifting chunk boundaries — the
+    parity contract is that any logit row both layouts compute for the
+    same (request, position) is the same row, bitwise. Decode keys also
+    return separately: decode schedules must agree exactly."""
+    rows: dict[tuple[int, int], np.ndarray] = {}
+    decode_keys: set[tuple[int, int]] = set()
+    orig_prefill, orig_decode = eng.prefill, eng.decode
+
+    def prefill(prompt, **kw):
+        tok, lg = orig_prefill(prompt, **kw)
+        base = kw.get("base", 0)
+        for j in range(np.asarray(lg).shape[0]):
+            rows[(kw["request_id"], base + j)] = np.asarray(lg)[j].copy()
+        return tok, lg
+
+    def decode(last, lengths, ids, active, **kw):
+        nxt, lg = orig_decode(last, lengths, ids, active, **kw)
+        for s in np.nonzero(np.asarray(active, bool))[0]:
+            key = (int(ids[s]), int(lengths[s]))
+            rows[key] = np.asarray(lg)[s].copy()
+            decode_keys.add(key)
+        return nxt, lg
+
+    eng.prefill, eng.decode = prefill, decode
+    return rows, decode_keys
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_paged_decode_bitwise_equals_contiguous(tp):
+    """THE ISSUE 7 acceptance pin: the staggered shared-prefix workload
+    with prefix sharing AND chunked prefill on, served by the paged
+    engine, produces BIT-IDENTICAL per-request tokens and per-step
+    logits to the contiguous oracle — tp=1 and tp=2 — while actually
+    sharing (hits > 0, so the pin is not vacuous). Every decode tick's
+    (request, position) is computed by BOTH layouts and agrees bitwise
+    at whatever page-count bucket the paged engine ran; every prefill
+    position computed by both agrees bitwise too (hit depths may differ
+    — paged entries cover floor-to-page — so prefill key SETS may
+    differ; the shared keys may not)."""
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=2, per_family=3, prefix_len=12, tail_min=2, tail_max=6,
+        vocab=SPEC.vocab, seed=16,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=5, arrival=i % 3)
+            for i, p in enumerate(prompts)]
+    base = dict(spec=SPEC, slots=2, capacity=64, tensor_parallel=tp,
+                prefix_slots=2, prefill_chunk=8, prefill_budget=8)
+    ec = InferenceEngine(ServeConfig(**base))
+    rows_c, dec_c = _capture_logits(ec)
+    done_c, _ = Scheduler(ec).run(reqs)
+    ep = InferenceEngine(ServeConfig(**base, page_size=8, num_pages=16))
+    rows_p, dec_p = _capture_logits(ep)
+    done_p, stats = Scheduler(ep).run(reqs)
+    assert stats.prefix_hits > 0  # sharing actually happened
+    for r in reqs:
+        assert done_p[r.id].tokens == done_c[r.id].tokens, (tp, r.id)
+    # Decode ticks agree exactly: same (request, position) schedule.
+    assert dec_p == dec_c and dec_c
+    common = set(rows_c) & set(rows_p)
+    assert common >= dec_c  # every decode position is in both
+    for key in sorted(common):
+        np.testing.assert_array_equal(rows_c[key], rows_p[key],
+                                      err_msg=str((tp, key)))
+
+
+# -- zero-copy sharing + refcounted reclamation -------------------------------
+
+
+def test_paged_prefix_hit_zero_copy_and_pool_reclaim():
+    """Acceptance: a paged prefix hit moves NO K/V rows beyond the one
+    copy-on-write partial tail page — asserted via the engine's
+    copy-program counter AND the prefix_map trace events (copied_rows
+    < page_size, page-aligned hits copy nothing) — and every page
+    reclaims when its last holder lets go: slots release at completion,
+    entries at eviction, after which the pool is whole and REUSABLE
+    (the rerun reproduces the first run's tokens)."""
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=2, per_family=3, prefix_len=16, tail_min=2, tail_max=6,
+        vocab=SPEC.vocab, seed=7,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=4, arrival=i % 2)
+            for i, p in enumerate(prompts)]
+    eng = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=64, prefix_slots=2,
+        page_size=8, num_pages=16,
+    ))
+    tracer = Tracer()
+    done, stats = Scheduler(eng, tracer=tracer).run(reqs)
+    assert stats.prefix_hits > 0
+    maps = [r["attrs"] for r in tracer.records
+            if r.get("name") == "prefix_map"]
+    assert len(maps) == stats.prefix_hits
+    for attrs in maps:
+        # Zero copies beyond the partial tail page: page-aligned hits
+        # copy nothing, unaligned ones exactly hit % page_size rows.
+        assert attrs["copied_rows"] == attrs["rows"] % 8
+        assert attrs["copied_rows"] < 8
+    assert eng.page_copies == sum(1 for a in maps if a["copied_rows"])
+    # No contiguous-style full-prefix copy program even exists on this
+    # path; the only copies the run made are the tail pages above.
+    comp = [r["attrs"] for r in tracer.records
+            if r.get("name") == "complete"]
+    assert comp and all(a["kv_pages_held"] >= 1 for a in comp)
+    # All slots released; only prefix entries still hold pages, every
+    # held page carries exactly the live references.
+    assert (eng.table_len == 0).all()
+    held = sum(len(set(e.pages)) for e in eng.prefix._entries.values())
+    assert eng.pages.free == eng.num_pages - held
+    assert (eng.pages.refs >= 0).all()
+    # Evicting the (zero-ref) entries returns EVERY page: nothing leaks.
+    assert eng.reclaim_pages(eng.num_pages)
+    assert eng.pages.free == eng.num_pages
+    # Pool reusable: the rerun (cold index again) replays identically.
+    again, _ = Scheduler(eng).run(reqs)
+    for r in reqs:
+        assert again[r.id].tokens == done[r.id].tokens
+
+
+def test_paged_pinned_pages_survive_reclaim_pressure():
+    """The refcount half of reclamation, on the engine directly: pages
+    mapped by a LIVE slot (and the entry it pinned) survive a full
+    reclaim sweep — only zero-ref entries' pages free — and release
+    order doesn't matter (slot then entry, or entry then slot)."""
+    eng = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=32, prefix_slots=2,
+        page_size=8, num_pages=8,
+    ))
+    prompt = np.zeros(16, np.int32)
+    eng.prefill(prompt, slot=0, request_id=0)
+    assert eng.prefix_store(prompt, 0)  # donates pages 0,1 (zero-copy)
+    assert eng.pages.shared == 2
+    entry, hit = eng.prefix.match(prompt)
+    eng.prefix_fetch(entry, 8, 1)  # page-aligned: zero copies
+    assert eng.page_copies == 0
+    assert eng.pages.refs[0] == 3  # slot 0 + entry + slot 1
+    # Reclaim pressure frees nothing: the only entry is pinned.
+    assert not eng.reclaim_pages(eng.num_pages)
+    assert eng.prefix.skipped_full == 0  # reclaim, not registration
+    eng.release_slot(1)
+    eng.prefix_release(entry)
+    # Entry now ZERO-REF but its pages are still mapped by live slot 0:
+    # evicting it would free nothing — reclaim must leave it resident
+    # (a fruitless eviction only burns future hits) and report failure.
+    assert not eng.reclaim_pages(eng.num_pages)
+    assert len(eng.prefix) == 1
+    eng.release_slot(0)
+    assert eng.pages.free == eng.num_pages - 2  # entry's 2 pages remain
+    assert eng.reclaim_pages(eng.num_pages)  # now actually freeable
+    assert eng.pages.free == eng.num_pages
+
+
+# -- pooled capacity: admission is "enough free pages" ------------------------
+
+
+def test_paged_pool_admission_defers_until_pages_free():
+    """Capacity pooling admits by PAGES, not worst-case slots: a pool
+    too small to co-host the head request waits (strict FIFO) and
+    admits once a finishing request frees pages — the run completes
+    with tokens bit-identical to a generous-pool run, and the deferral
+    actually happened (the waiter's admission follows a completion)."""
+    prompts = synthesize_longtail_prompts(
+        num_short=2, num_long=1, short_min=6, short_max=10, long_len=24,
+        long_prefix_len=1, vocab=SPEC.vocab, seed=3,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=4)
+            for i, p in enumerate(prompts)]
+    tight = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=32, page_size=8, num_pages=5,
+    ))
+    sched = Scheduler(tight)
+    # Warmup must survive a TIGHT pool too (its compile ladders cap
+    # their page use; clone-run residue is reset away first).
+    sched.warmup(reqs)
+    done_t, _ = sched.run(reqs)
+    roomy = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=32, page_size=8, num_pages=8,
+    ))
+    done_r, _ = Scheduler(roomy).run(reqs)
+    for r in reqs:
+        assert done_t[r.id].status == "ok"
+        assert done_t[r.id].tokens == done_r[r.id].tokens, r.id
+    # The long request (4 pages of 5) could not co-reside with both
+    # shorts: somebody was admitted only after another finished.
+    starts = sorted(done_t[i].admitted_step for i in done_t)
+    first_finish = min(done_t[i].finished_step for i in done_t)
+    assert starts[-1] >= first_finish
+    # The generous pool co-hosted freely: both slots filled at step 0.
+    assert sorted(done_r[i].admitted_step for i in done_r)[1] == 0
+    assert tight.pages.free == tight.num_pages  # nothing leaked
+
+
+def test_paged_reclaim_evicting_the_matched_entry_is_safe():
+    """Admission under page pressure may reclaim the very entry the
+    pending request just matched (it was zero-ref — exactly what
+    reclaim evicts). The scheduler must re-probe after reclaiming:
+    fetching the ghost entry would KeyError and the reservation would
+    be undersized. Constructed so the first reclaim evicts the matched
+    family prefix AND the re-probed need forces a second reclaim —
+    the request then admits as a full prefill with correct tokens."""
+    ps = 4
+    mk = lambda: InferenceEngine(ServeConfig(
+        spec=SPEC, slots=2, capacity=20, prefix_slots=2,
+        page_size=ps, num_pages=5,
+    ))
+    eng = mk()
+    prompt_a = np.arange(8, dtype=np.int32) % SPEC.vocab
+    prompt_a2 = (np.arange(4, dtype=np.int32) + 9) % SPEC.vocab
+    sched = Scheduler(eng)
+    sched.run([Request(id=0, prompt=prompt_a, max_new_tokens=2),
+               Request(id=1, prompt=prompt_a2, max_new_tokens=2,
+                       arrival=1)])
+    assert len(eng.prefix) == 2  # both registered, 3 pages pinned
+    assert eng.pages.available == 2
+    # B shares A's full prompt: matches entry A (2 shared pages), but
+    # needs 5 pages total -> need 3 > available 2 -> reclaim evicts the
+    # MATCHED zero-ref entry A first (LRU), then A2 on the re-probed
+    # round -> full prefill, 5 fresh pages.
+    prompt_b = np.concatenate([prompt_a, prompt_a[1:2]]).astype(np.int32)
+    done, stats = sched.run([Request(id=7, prompt=prompt_b,
+                                     max_new_tokens=11)])
+    assert done[7].status == "ok" and len(done[7].tokens) == 11
+    assert len(eng.prefix) <= 1  # the old entries were reclaimed
+    # Correctness: same tokens as a fresh engine with no cache history.
+    fresh, _ = Scheduler(mk()).run([Request(id=7, prompt=prompt_b,
+                                            max_new_tokens=11)])
+    assert fresh[7].tokens == done[7].tokens
+
+
+def test_paged_deadline_eviction_releases_pages_and_keeps_parity():
+    """The deadline-eviction interaction (acceptance): a stalled
+    request admitted onto the paged pool (pages reserved, prefix
+    pinned) expires at its deadline — pages AND reservation return to
+    the pool, refs release — while co-residents' tokens stay
+    bit-identical to the contiguous oracle under the same fault, with
+    chunked prefill on (the full ISSUE 6 x ISSUE 7 composition)."""
+    from ddl_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=1, per_family=3, prefix_len=12, tail_min=2, tail_max=4,
+        vocab=SPEC.vocab, seed=9,
+    )
+    reqs = [
+        Request(id=0, prompt=prompts[0], max_new_tokens=4),
+        Request(id=1, prompt=prompts[1], max_new_tokens=4, arrival=1,
+                deadline_s=0.02),
+        Request(id=2, prompt=prompts[2], max_new_tokens=4, arrival=1),
+    ]
+    outs = {}
+    for paged in (0, 8):
+        eng = InferenceEngine(ServeConfig(
+            spec=SPEC, slots=2, capacity=64, prefix_slots=2,
+            prefill_chunk=8, page_size=paged,
+            num_pages=16 if paged else 0,
+        ))
+        inj = FaultInjector(FaultSpec(kind="stall", step=1))
+        done, _ = Scheduler(eng, injector=inj).run(reqs)
+        assert done[1].status == "deadline_exceeded"
+        assert done[0].status == "ok" and done[2].status == "ok"
+        outs[paged] = {i: done[i].tokens for i in done}
+        if paged:
+            # Eviction released the stalled slot's pages + reservation;
+            # only prefix entries hold pages now.
+            assert (eng.table_len == 0).all()
+            assert eng.pages.reserved == 0
+            assert eng.reclaim_pages(eng.num_pages)
+            assert eng.pages.free == eng.num_pages
+            # Pool reusable after eviction (the PR 6 contract, paged).
+            again, _ = Scheduler(eng).run(
+                [Request(id=3, prompt=prompts[1], max_new_tokens=2)]
+            )
+            assert again[3].status == "ok"
+    assert outs[0] == outs[8]  # paged ≡ contiguous under eviction
