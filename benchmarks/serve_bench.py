@@ -32,6 +32,16 @@ Plus head-to-head sections (ISSUE 4/7; skip with ``--skip-compare``):
   (CoW tail-page copies vs full-prefix row copies) and the pool gauges
   (``serve_kv_pages_free`` / ``serve_kv_pages_shared``), with the
   ``tokens_identical`` integrity bit across LAYOUTS.
+- **router_compare** (ISSUE 8) — the multi-tenant front door: a
+  1-replica router must serve the bare scheduler's exact tokens
+  (transparency, checked in situ), then a 2-replica router takes a
+  three-class mixed stream with a mid-run burst twice — prefix
+  affinity ON vs OFF — recording per-class TTFT/ITL SLO attainment,
+  the chat-family prefix hit rate the placement policy exists to lift,
+  and the priority-shed ledger (bulk absorbs the burst; the
+  ``chat_shed`` row records any strays — affinity CONCENTRATES family
+  traffic, which can cost a straggler on the loaded replica, a trade
+  the A/B makes visible instead of hiding).
 - **longtail_compare** (ISSUE 7) — capacity POOLING made concrete: a
   long-tail prompt mix under one fixed row budget. The slot-major arm
   (budget / slots rows per slot) must REJECT the long requests at
@@ -438,6 +448,111 @@ def main() -> None:
                 failed["longtail_paged"] = {"error_type": type(e).__name__,
                                             "error": str(e)[:300]}
 
+    # -- multi-tenant router (ISSUE 8): 1-replica transparency + N=2
+    # mixed-burst affinity A/B with per-class SLO attainment --------------
+    router_compare = {}
+    if not args.skip_compare:
+        import dataclasses as _dc
+
+        from ddl_tpu.data.lm import synthesize_mixed_traffic
+        from ddl_tpu.serve import ClassSpec, Router, RouterConfig
+
+        if left() < 300:
+            note = "deadline: router_compare skipped"
+            router_compare["skipped"] = note
+            print(f"[serve_bench] {note}", file=sys.stderr)
+        else:
+            # (a) transparency: one replica behind the router serves the
+            # SAME stream as the bare scheduler with identical tokens —
+            # checked in situ (the bitwise tokens+logits pin is
+            # tests/test_router.py's).
+            par_reqs = [
+                Request(id=i, prompt=p, max_new_tokens=16, arrival=i)
+                for i, p in enumerate(prompts[:6])
+            ]
+            try:
+                cfg1 = ServeConfig(**base_cfg)
+                sched = Scheduler(InferenceEngine(cfg1))
+                sched.warmup(par_reqs)
+                bare_done, _ = sched.run(par_reqs)
+                r1 = Router(RouterConfig(serve=cfg1, replicas=1,
+                                         classes=(ClassSpec("default"),)))
+                r1.warmup(par_reqs)
+                rd, _ = r1.run(par_reqs)
+                router_compare["single_replica_tokens_identical"] = (
+                    {i: bare_done[i].tokens for i in bare_done}
+                    == {i: rd[i].tokens for i in rd}
+                )
+                print(f"[serve_bench] router parity: tokens_identical="
+                      f"{router_compare['single_replica_tokens_identical']}",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — record, don't discard
+                failed["router_parity"] = {"error_type": type(e).__name__,
+                                           "error": str(e)[:300]}
+            # (b) 2 replicas, three-class mixed load with a mid-stream
+            # burst, prefix affinity ON vs OFF: per-class SLO attainment
+            # and the chat hit rate are the decision rows; priority
+            # shedding must land on bulk, never chat.
+            # The burst is BULK-ONLY and the class margins are wide
+            # (bulk sheds 6 below the threshold, longdoc 3) so the
+            # overload lands where the policy says it should: bulk
+            # sheds absorb the burst (chat_shed records any straggler
+            # the affinity arm's family concentration costs). The
+            # affinity window matches the chat family prefix exactly —
+            # a wider window would fold post-prefix tokens into the
+            # sticky key and no two family members would ever share it.
+            traffic = synthesize_mixed_traffic(
+                classes={
+                    "chat": dict(rate=0.7, prompt_min=16, prompt_max=48,
+                                 max_new_tokens=16, families=4,
+                                 family_prefix_len=12),
+                    "longdoc": dict(
+                        rate=0.15, prompt_min=64,
+                        prompt_max=min(args.capacity - 32, 160),
+                        max_new_tokens=16,
+                    ),
+                    "bulk": dict(rate=0.5, prompt_min=16, prompt_max=48,
+                                 max_new_tokens=24),
+                },
+                horizon=20, vocab=args.vocab, seed=6,
+                burst=(4, 8, 3.0, "bulk"), max_requests=36,
+            )
+            rbase = RouterConfig(
+                serve=ServeConfig(**base_cfg, prefix_slots=4),
+                replicas=2,
+                affinity_window=12,
+                classes=(
+                    ClassSpec("chat", ttft_slo_s=5.0, itl_slo_s=0.5,
+                              priority=0),
+                    ClassSpec("longdoc", ttft_slo_s=30.0, itl_slo_s=1.0,
+                              priority=1, shed_margin=3),
+                    ClassSpec("bulk", ttft_slo_s=120.0, itl_slo_s=5.0,
+                              priority=2, shed_margin=6),
+                ),
+                shed_threshold=12,
+            )
+            for label, aff in (("affinity_on", True),
+                               ("affinity_off", False)):
+                try:
+                    router = Router(_dc.replace(rbase,
+                                                prefix_affinity=aff))
+                    router.warmup(traffic)
+                    done, rs = router.run(traffic)
+                    row = rs.summary()
+                    row["chat_shed"] = rs.per_class["chat"].shed \
+                        if "chat" in rs.per_class else 0
+                    router_compare[label] = row
+                    chat_ttft = row["per_class"]["chat"]["ttft_ms"]["p95"]
+                    print(f"[serve_bench] router {label}: hit rate "
+                          f"{row['prefix_hit_rate']:.0%}, sheds "
+                          f"{row['router_sheds']}, chat ttft p95 "
+                          f"{chat_ttft:.0f}ms", file=sys.stderr)
+                except Exception as e:  # noqa: BLE001
+                    failed[f"router_{label}"] = {
+                        "error_type": type(e).__name__,
+                        "error": str(e)[:300],
+                    }
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -514,6 +629,7 @@ def main() -> None:
         "chunk_compare": chunk_compare,
         "paged_compare": paged_compare,
         "longtail_compare": longtail_compare,
+        "router_compare": router_compare,
         "prefix_len": args.prefix_len,
         "prefill_chunk": args.prefill_chunk,
         "page_size": args.page_size,

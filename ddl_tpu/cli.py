@@ -372,7 +372,35 @@ def build_parser() -> argparse.ArgumentParser:
                          "(occupied slots + waiting eligibles) is "
                          "refused with status 'shed' instead of "
                          "collapsing admitted traffic's ITL; must be "
-                         ">= --slots")
+                         ">= --slots (with --replicas: per replica, and "
+                         "the reference point class shed margins "
+                         "subtract from)")
+    sv.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="multi-tenant front door (ddl_tpu.serve.router): "
+                         "run N independent scheduler/engine replicas "
+                         "(each with its own KV pool and prefix index, "
+                         "sharing one checkpoint's params) behind an "
+                         "SLO-aware router — prefix-affinity placement, "
+                         "per-class priority shedding, per-class TTFT/ITL "
+                         "accounting. Drives the --traffic stream instead "
+                         "of the --num-prompts set")
+    sv.add_argument("--traffic", default=None, metavar="SPEC",
+                    help="mixed-traffic scenario for --replicas "
+                         "(data.lm.synthesize_mixed_traffic): ';'-joined "
+                         "segments — global keys horizon=N, seed=N, "
+                         "max_requests=N, burst=START:LEN:MULT[:CLASS], "
+                         "diurnal=AMPLITUDE:PERIOD — and class segments "
+                         "NAME:rate=R,pmin=A,pmax=B,new=T"
+                         "[,families=F,fprefix=L]. Default: the "
+                         "three-class chat/longdoc/bulk mix at horizon 32")
+    sv.add_argument("--slo", default=None, metavar="SPEC",
+                    help="per-class SLO targets/priorities for "
+                         "--replicas: ';'-joined NAME:ttft=S,itl=S,"
+                         "priority=P[,margin=M] segments (seconds; "
+                         "priority 0 = most protected; margin defaults "
+                         "to priority — how far below --shed-threshold "
+                         "the class starts shedding at the router). "
+                         "Unnamed classes get defaults")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -606,6 +634,7 @@ _SERVE_ONLY_DESTS = (
     "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
     "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
     "prefill_budget", "ttft_deadline", "request_deadline", "shed_threshold",
+    "replicas", "traffic", "slo",
 )
 
 
@@ -852,6 +881,152 @@ def _run_lm(args) -> int:
     return 0
 
 
+def _class_tallies(done, cls_of) -> dict:
+    """Per-class completion/status tallies for the serve JSON (ISSUE 8
+    satellite): chaos chains assert shedding hit the RIGHT class from
+    this, instead of grepping completion lists."""
+    out: dict = {}
+    for i, c in done.items():
+        row = out.setdefault(cls_of.get(i, "default"), {
+            "total": 0, "ok": 0, "shed": 0, "deadline_exceeded": 0,
+        })
+        row["total"] += 1
+        row[c.status] = row.get(c.status, 0) + 1
+    return out
+
+
+def _run_serve_router(args, cfg) -> int:
+    """The ``--replicas`` path of the serve variant (ISSUE 8): an
+    SLO-aware router (``ddl_tpu.serve.router``) over N scheduler/engine
+    replicas sharing one checkpoint's params, driving the ``--traffic``
+    mixed-scenario stream with per-class SLO accounting."""
+    from .data.lm import DEFAULT_TRAFFIC_CLASSES, synthesize_mixed_traffic
+    from .serve.router import (
+        Router,
+        RouterConfig,
+        parse_slo_spec,
+        parse_traffic_spec,
+    )
+    from .train.trainer import checkpoint_file
+
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    # The bare path's prompt-set shape flags have no meaning here — the
+    # per-class shapes come from --traffic. Loud-fail, not silent-ignore.
+    defaults = build_parser()
+    for dest in ("num_prompts", "prompt_min", "prompt_max",
+                 "max_new_tokens"):
+        if getattr(args, dest) != defaults.get_default(dest):
+            raise SystemExit(
+                f"--{dest.replace('_', '-')} does not apply with "
+                "--replicas (per-class prompt/token shapes come from "
+                "--traffic)"
+            )
+    try:
+        gen_kw = (parse_traffic_spec(args.traffic) if args.traffic
+                  else {"classes": dict(DEFAULT_TRAFFIC_CLASSES)})
+        gen_kw.setdefault("horizon", 32)
+        gen_kw.setdefault("seed", args.seed)
+        gen_kw.setdefault("vocab", args.vocab)
+        traffic = synthesize_mixed_traffic(**gen_kw)
+        class_specs = parse_slo_spec(args.slo or "",
+                                     set(gen_kw["classes"]))
+        rcfg = RouterConfig(
+            serve=cfg, replicas=args.replicas, classes=class_specs,
+            shed_threshold=args.shed_threshold,
+            ttft_deadline_s=args.ttft_deadline,
+            deadline_s=args.request_deadline,
+        )
+    except ValueError as e:
+        raise SystemExit(f"serve config error: {e}")
+    if not traffic:
+        raise SystemExit(
+            "serve config error: the --traffic scenario produced no "
+            "arrivals (raise a class rate or the horizon)"
+        )
+    for name, spec_d in gen_kw["classes"].items():
+        worst = (spec_d.get("prompt_max", 16)
+                 + spec_d.get("max_new_tokens", 8))
+        if worst > cfg.capacity:
+            raise SystemExit(
+                f"serve config error: class {name!r} worst case (pmax + "
+                f"new = {worst}) exceeds --capacity {cfg.capacity}"
+            )
+    ckpt = checkpoint_file(args.checkpoint_dir)
+    if ckpt is not None:
+        import os
+
+        if not os.path.exists(ckpt):
+            raise SystemExit(f"no checkpoint at {ckpt}")
+    registry, writer, _ = _build_obs(args, config=cfg, make_tracer=False)
+    tracer = None
+    if args.trace_dir:
+        from .obs.trace import Tracer, host_trace_file
+
+        # keep=True: the per-class SLO derivation reads the records
+        # back, in addition to streaming them to the trace file.
+        tracer = Tracer(host_trace_file(args.trace_dir), keep=True)
+    injector = _make_injector(args, "serve")
+    try:
+        router = (
+            Router.from_checkpoint(rcfg, ckpt, registry=registry,
+                                   tracer=tracer, injector=injector)
+            if ckpt is not None else
+            Router(rcfg, registry=registry, tracer=tracer,
+                   injector=injector)
+        )
+    except (ValueError, KeyError) as e:
+        raise SystemExit(f"serve config error: {e}")
+    if ckpt is not None:
+        print(f"[ddl_tpu] serving params from {ckpt} (params-only load, "
+              f"placed once for {args.replicas} replicas)")
+    # Compile outside the reported run (every replica may receive any
+    # request, so each warms on the whole stream); the XLA timeline
+    # starts after warmup, exactly like the single-engine path.
+    router.warmup(traffic)
+    from .utils.metrics import trace as profiler_trace
+
+    try:
+        with profiler_trace(args.trace_dir):
+            done, rstats = router.run(traffic)
+    finally:
+        if tracer is not None:
+            tracer.close()
+        if writer is not None:
+            writer.close()
+    cls_of = {m.id: m.traffic_class for m in traffic}
+    summary = rstats.summary()
+    for name, row in summary["per_class"].items():
+        print(f"class {name}: {row['requests']} requests -> "
+              f"ok {row['ok']} shed {row['shed']} deadline "
+              f"{row['deadline_exceeded']} | ttft p95 "
+              f"{row['ttft_ms']['p95']:.1f}ms itl p95 "
+              f"{row['itl_ms']['p95']:.1f}ms | slo attained ttft "
+              f"{row['ttft_slo_attained']:.0%} itl "
+              f"{row['itl_slo_attained']:.0%}")
+    print(f"router: {args.replicas} replicas | placements "
+          f"{summary['per_replica_requests']} (affinity "
+          f"{rstats.affinity_placements}, load {rstats.load_placements}) "
+          f"| router sheds {rstats.router_sheds} | prefix hit rate "
+          f"{rstats.prefix_hit_rate:.0%}")
+    if args.json:
+        print(json.dumps({
+            "variant": "serve",
+            "config": dataclasses.asdict(cfg),
+            "replicas": args.replicas,
+            "router": summary,
+            "per_class": _class_tallies(done, cls_of),
+            "completions": {
+                str(i): {"prompt_len": done[i].prompt_len,
+                         "tokens": done[i].tokens,
+                         "status": done[i].status,
+                         "traffic_class": cls_of.get(i, "default")}
+                for i in sorted(done)
+            },
+        }))
+    return 0
+
+
 def _run_serve(args) -> int:
     """The ``serve`` variant: continuous-batching KV-cache decode over a
     deterministic seeded prompt set (platform setup already done by
@@ -901,6 +1076,12 @@ def _run_serve(args) -> int:
         raise SystemExit(
             "--top-k requires --temperature > 0 (greedy decode ignores it)"
         )
+    if args.traffic is not None and args.replicas is None:
+        raise SystemExit("--traffic requires --replicas (the router path)")
+    if args.slo is not None and args.replicas is None:
+        raise SystemExit("--slo requires --replicas (the router path)")
+    if args.replicas is not None:
+        return _run_serve_router(args, cfg)
     if args.max_new_tokens < 1:
         raise SystemExit(
             f"--max-new-tokens must be >= 1, got {args.max_new_tokens}"
@@ -1004,6 +1185,13 @@ def _run_serve(args) -> int:
                          "status": done[i].status}
                 for i in sorted(done)
             },
+            # Per-class completion/status tallies (ISSUE 8 satellite):
+            # the single-engine path serves one "default" class, the
+            # --replicas router path real ones — chaos chains assert
+            # shedding hit the right class from this either way.
+            "per_class": _class_tallies(
+                done, {r.id: r.traffic_class for r in requests}
+            ),
             "prefill_tokens_per_s": stats.prefill_tokens_per_s,
             "decode_tokens_per_s_per_slot":
                 stats.decode_tokens_per_s_per_slot,

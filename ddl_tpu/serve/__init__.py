@@ -7,7 +7,11 @@ batching — the inference half of the sharded-mesh story.
 - ``serve.prefix``    — host prefix-cache index (trie + refcounted LRU;
   paged entries own refcounted page lists — zero-copy sharing)
 - ``serve.scheduler`` — continuous batching over the engine (paged mode
-  admits by free pages, pooling capacity across slots)
+  admits by free pages, pooling capacity across slots); externally
+  drivable tick by tick (begin/submit/tick/collect + pressure())
+- ``serve.router``    — the multi-tenant front door: SLO-aware routing
+  of classed traffic over N scheduler/engine replicas (prefix-affinity
+  placement, priority shedding, per-class SLO accounting)
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
 
@@ -22,21 +26,39 @@ Quickstart (also ``python -m ddl_tpu serve --help``)::
 
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .prefix import PrefixIndex  # noqa: F401
+from .router import (  # noqa: F401
+    ClassSpec,
+    Router,
+    RouterConfig,
+    RouterStats,
+    parse_slo_spec,
+    parse_traffic_spec,
+)
 from .scheduler import (  # noqa: F401
     Completion,
+    Pressure,
     Request,
     Scheduler,
     ServeStats,
     derive_request_slo,
+    request_slo_samples,
 )
 
 __all__ = [
+    "ClassSpec",
     "Completion",
     "InferenceEngine",
     "PrefixIndex",
+    "Pressure",
     "Request",
+    "Router",
+    "RouterConfig",
+    "RouterStats",
     "Scheduler",
     "ServeConfig",
     "ServeStats",
     "derive_request_slo",
+    "parse_slo_spec",
+    "parse_traffic_spec",
+    "request_slo_samples",
 ]

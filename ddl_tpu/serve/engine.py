@@ -172,9 +172,19 @@ class InferenceEngine:
     """Owns the placed params, the cache state, and the compiled
     program pair. ``params`` is a host pytree (e.g. a fresh init or a
     ``utils.checkpoint.load_params`` result); ``None`` seeds a random
-    init — the smoke/demo path."""
+    init — the smoke/demo path. ``placed_params`` instead SHARES an
+    already-placed device tree from another engine on an identical
+    mesh (the multi-replica router's one-checkpoint contract,
+    ISSUE 8) — no re-placement, no transient duplicate copy; safe
+    because no compiled program donates the params argument."""
 
-    def __init__(self, config: ServeConfig, params=None):
+    def __init__(self, config: ServeConfig, params=None, *,
+                 placed_params=None):
+        if params is not None and placed_params is not None:
+            raise ValueError(
+                "pass params (host tree, placed here) OR placed_params "
+                "(an already-placed tree to share), not both"
+            )
         tp = config.tensor_parallel
         spec = config.spec
         if tp < 1:
@@ -265,11 +275,15 @@ class InferenceEngine:
         self.mesh = make_mesh(tp, axis=TP_AXIS)
         self._pspecs = lm_param_specs(spec, tp)
         self._cspecs = cache_specs(tp)
-        if params is None:
-            params = transformer.init_lm_params(
-                jax.random.PRNGKey(config.seed), spec
-            )
-        self.params = multihost.put_tree(self.mesh, self._pspecs, params)
+        if placed_params is not None:
+            self.params = placed_params
+        else:
+            if params is None:
+                params = transformer.init_lm_params(
+                    jax.random.PRNGKey(config.seed), spec
+                )
+            self.params = multihost.put_tree(self.mesh, self._pspecs,
+                                             params)
         self._row_reduce = coll.tp_allreduce(TP_AXIS) if tp > 1 else None
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None

@@ -1,0 +1,682 @@
+"""Multi-tenant front door: an SLO-aware router over N engine replicas
+(ISSUE 8 / ROADMAP item 4) — the inference analogue of the paper's
+sharded-PS load spreading, and of its async axis (replicas tick
+independently; nothing synchronizes them but the router's clock).
+
+The single-engine stack (``serve.scheduler`` driving ``serve.engine``)
+serves one continuous batch. Production traffic is heterogeneous —
+short interactive chat, long-document analysis, bulk offline generation
+— and one batch is one blast radius: a long prefill or a bulk burst
+stalls every tenant. The router owns ``replicas`` independent
+``Scheduler``/``InferenceEngine`` pairs (each with its own KV pool and
+prefix index, all serving ONE checkpoint's params — placed once and
+shared across replicas) and spreads an open-loop request stream
+(``data.lm.synthesize_mixed_traffic``) over them:
+
+- **Prefix-affinity placement**: a request goes to the replica whose
+  ``PrefixIndex`` already covers its prompt (the probe is PURE — no LRU
+  stamp), falling back to a sticky family map (hash of the prompt's
+  page-aligned leading window, so the SECOND member of a family follows
+  the first even before registration completes), falling back to least
+  load. Load is read through ``Scheduler.pressure()`` — occupied slots,
+  queue backlog, free pages — never private state.
+- **Priority admission**: every request carries a ``traffic_class``;
+  classes carry priorities. When every replica's backlog is within
+  ``shed_margin`` (default: the priority) of the shed threshold, LOW
+  priority classes shed at the ROUTER — bulk degrades before chat — and
+  each replica's own PR-6 shed/deadline machinery remains the last
+  line for whatever was admitted.
+- **Per-class SLO accounting**: the replicas share one tracer, so
+  ``derive_request_slo(records, group_by=class_of)`` recovers per-class
+  (and per-replica) TTFT/ITL from one stream with the single
+  ``StepStats.from_times`` percentile definition; ``RouterStats``
+  reports per-class attainment against each class's targets, and the
+  registry gets ``{class=...}``-labeled histograms/counters.
+
+**Determinism contract**: the router owns a global tick clock. Arrivals
+are routed when the clock reaches them (decisions read only
+deterministic host state: pressure counts, pure prefix probes, the
+sticky map), then every non-idle replica ticks once, round-robin in
+replica order. An idle scheduler tick makes no device calls, so a
+1-replica router run is BIT-IDENTICAL (tokens and per-step logits) to
+``Scheduler.run`` on the same stream, and an N-replica run is
+seed-reproducible — same tokens, same placements — as long as
+wall-clock deadlines are off (deadlines evict on real time, exactly as
+in the bare scheduler). Pinned in tests/test_router.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from ..models import transformer
+from ..obs.trace import Tracer
+from .engine import InferenceEngine, ServeConfig
+from .scheduler import (
+    MIN_PREFIX_HIT,
+    Completion,
+    Request,
+    Scheduler,
+    ServeStats,
+    request_slo_samples,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassSpec:
+    """One traffic class's SLO contract. ``ttft_slo_s``/``itl_slo_s``
+    are attainment targets (accounting only — they gate no scheduling);
+    ``priority`` orders classes under overload (0 = most protected);
+    ``shed_margin`` is how many requests BELOW the shed threshold this
+    class starts shedding at the router (default: ``priority`` — lower
+    priority sheds earlier), so bulk absorbs a burst before chat feels
+    it."""
+
+    name: str
+    ttft_slo_s: float = math.inf
+    itl_slo_s: float = math.inf
+    priority: int = 0
+    shed_margin: int | None = None
+
+    @property
+    def margin(self) -> int:
+        return self.priority if self.shed_margin is None else self.shed_margin
+
+
+# Targets for the canonical three-class mix — illustrative CPU-scale
+# numbers (BASELINE.md records measured attainment; TPU rows pending).
+DEFAULT_CLASS_SPECS: tuple[ClassSpec, ...] = (
+    ClassSpec("chat", ttft_slo_s=0.5, itl_slo_s=0.1, priority=0),
+    ClassSpec("longdoc", ttft_slo_s=5.0, itl_slo_s=0.25, priority=1),
+    ClassSpec("bulk", ttft_slo_s=60.0, itl_slo_s=2.0, priority=2),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router topology + policy. ``serve`` configures EACH replica
+    (slots, capacity, paging, prefix pool — all per replica);
+    ``classes`` declares the traffic classes the stream may carry
+    (unknown classes are submit-time errors). ``shed_threshold`` is the
+    per-replica outstanding-work bound the PR-6 machinery enforces,
+    AND the reference point the router's class margins subtract from;
+    None disables shedding everywhere. ``prefix_affinity=False``
+    degrades placement to pure least-load (the A/B lever
+    serve_bench's router_compare measures). ``affinity_window`` bounds
+    the sticky family key (tokens; page-aligned on paged engines) —
+    size it <= the shared-prefix length your traffic actually carries:
+    a wider window folds post-prefix tokens into the key and no two
+    family members ever share it (the live index probe still works,
+    but only after the first member's registration lands)."""
+
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    replicas: int = 2
+    classes: tuple[ClassSpec, ...] = DEFAULT_CLASS_SPECS
+    prefix_affinity: bool = True
+    affinity_window: int = 16
+    shed_threshold: int | None = None
+    eos_id: int | None = None
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
+
+
+@dataclasses.dataclass
+class ClassReport:
+    """Per-class outcome of one router run. ``ttft``/``itl`` pool the
+    PER-REQUEST samples (``serve.request_slo_samples``) of the class's
+    members; attainment counts a shed/expired request as a MISS (it got
+    no first token), so ``ttft_slo_attained`` is the fraction of ALL
+    the class's requests served within target."""
+
+    name: str
+    requests: int
+    ok: int
+    shed: int
+    deadline_exceeded: int
+    ttft: object  # StepStats
+    itl: object  # StepStats
+    ttft_slo_attained: float
+    # No ITL samples reads 1.0 only when the class completed requests
+    # (1-token answers have no gaps); a fully-shed class reads 0.0.
+    itl_slo_attained: float
+
+
+@dataclasses.dataclass
+class RouterStats:
+    """One router run's accounting: per-class SLO reports, placement
+    ledger (request id -> replica), policy counters, and each replica's
+    own ``ServeStats``."""
+
+    per_class: dict[str, ClassReport]
+    placements: dict[int, int]
+    affinity_placements: int
+    load_placements: int
+    router_sheds: int
+    ticks: int
+    replica: list[ServeStats]
+
+    @property
+    def prefix_lookups(self) -> int:
+        return sum(s.prefix_lookups for s in self.replica)
+
+    @property
+    def prefix_hits(self) -> int:
+        return sum(s.prefix_hits for s in self.replica)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        lk = self.prefix_lookups
+        return self.prefix_hits / lk if lk else 0.0
+
+    def summary(self) -> dict:
+        """JSON-able digest (the CLI/serve_bench surface)."""
+        return {
+            "per_class": {
+                name: {
+                    "requests": r.requests,
+                    "ok": r.ok,
+                    "shed": r.shed,
+                    "deadline_exceeded": r.deadline_exceeded,
+                    "ttft_ms": {"p50": r.ttft.p50_ms, "p95": r.ttft.p95_ms},
+                    "itl_ms": {"p50": r.itl.p50_ms, "p95": r.itl.p95_ms},
+                    "ttft_slo_attained": r.ttft_slo_attained,
+                    "itl_slo_attained": r.itl_slo_attained,
+                }
+                for name, r in sorted(self.per_class.items())
+            },
+            "replicas": len(self.replica),
+            "per_replica_requests": [
+                sum(1 for v in self.placements.values() if v == k)
+                for k in range(len(self.replica))
+            ],
+            "affinity_placements": self.affinity_placements,
+            "load_placements": self.load_placements,
+            "router_sheds": self.router_sheds,
+            "prefix_hit_rate": round(self.prefix_hit_rate, 3),
+            "ticks": self.ticks,
+        }
+
+
+class Router:
+    """The front door. Owns ``config.replicas`` scheduler/engine pairs
+    sharing one checkpoint's placed params; :meth:`run` drives an
+    open-loop stream (``data.lm.MixedRequest`` items, or ``Request``s
+    carrying ``traffic_class``) to completion and returns
+    ``(completions, RouterStats)``.
+
+    ``registry`` (optional) receives the router's ``{class=...}``-
+    labeled metrics AND hands each replica its own registry (exposed as
+    ``replica_registries`` — per-replica gauges/counters under the
+    standard ``serve_*`` names). ``tracer`` defaults to an in-memory
+    tracer shared by every replica — the per-class SLO derivation reads
+    its records, so pass ``keep=True`` tracers when supplying your
+    own."""
+
+    def __init__(self, config: RouterConfig, params=None, *,
+                 registry=None, tracer=None, injector=None):
+        if config.replicas < 1:
+            raise ValueError(
+                f"replicas must be >= 1, got {config.replicas}"
+            )
+        names = [c.name for c in config.classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate traffic class names in {names}")
+        if not names:
+            raise ValueError("at least one traffic class is required")
+        if config.affinity_window < 2:
+            raise ValueError(
+                f"affinity_window must be >= 2 (BOS + >= 1 payload "
+                f"token), got {config.affinity_window}"
+            )
+        if config.shed_threshold is not None:
+            for c in config.classes:
+                if config.shed_threshold - c.margin < 1:
+                    raise ValueError(
+                        f"class {c.name!r}: shed margin {c.margin} leaves "
+                        f"no admissible headroom under shed_threshold "
+                        f"{config.shed_threshold} (threshold - margin must "
+                        "be >= 1)"
+                    )
+        self.config = config
+        self.classes = {c.name: c for c in config.classes}
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.registry = registry
+        if params is None:
+            import jax
+
+            params = transformer.init_lm_params(
+                jax.random.PRNGKey(config.serve.seed), config.serve.spec
+            )
+        self.engines: list[InferenceEngine] = []
+        for k in range(config.replicas):
+            # One checkpoint, one placed copy: replica 0 places the
+            # host tree; every other replica SHARES its device arrays
+            # (prefill/decode donate only the cache argument, never
+            # params, so sharing is safe — and no replica ever pays a
+            # transient duplicate placement).
+            eng = (InferenceEngine(config.serve, params=params) if k == 0
+                   else InferenceEngine(
+                       config.serve,
+                       placed_params=self.engines[0].params))
+            self.engines.append(eng)
+        self.replica_registries = None
+        regs = [None] * config.replicas
+        if registry is not None:
+            from ..obs import MetricRegistry
+
+            self.replica_registries = [MetricRegistry()
+                                       for _ in range(config.replicas)]
+            regs = self.replica_registries
+        self.scheds = [
+            Scheduler(
+                eng, eos_id=config.eos_id, tracer=self.tracer,
+                registry=regs[k], shed_threshold=config.shed_threshold,
+                ttft_deadline_s=config.ttft_deadline_s,
+                deadline_s=config.deadline_s, injector=injector,
+            )
+            for k, eng in enumerate(self.engines)
+        ]
+        self._sticky: dict[bytes, int] = {}
+
+    @classmethod
+    def from_checkpoint(cls, config: RouterConfig, path, **kw) -> "Router":
+        """Build a router serving a checkpoint's params (params-only
+        load from any trained topology, placed ONCE for all
+        replicas)."""
+        from .engine import _load_host_params
+
+        return cls(config,
+                   params=_load_host_params(path, config.serve.spec), **kw)
+
+    def reset(self) -> None:
+        """Fresh caches/prefix pools on every replica and a cleared
+        sticky family map — two runs from the same reset point are
+        identical (the seed-determinism pin)."""
+        for eng in self.engines:
+            eng.reset()
+        self._sticky.clear()
+
+    def warmup(self, items) -> None:
+        """Compile every replica's program ladder for ``items`` outside
+        any timed run (each replica may receive any request, so each
+        warms on the whole stream), then reset."""
+        reqs = [self._to_request(it) for it in items]
+        for sched in self.scheds:
+            sched.warmup(reqs)
+        self.reset()
+
+    # -- placement policy --------------------------------------------------
+
+    def _to_request(self, it) -> Request:
+        """Accept ``data.lm.MixedRequest`` items or ``Request``s with a
+        ``traffic_class`` — the router's admission validates the class
+        name; shape/length validation stays with the scheduler."""
+        cls = getattr(it, "traffic_class", "default")
+        if cls not in self.classes:
+            raise ValueError(
+                f"request {it.id}: unknown traffic_class {cls!r} "
+                f"(declared: {sorted(self.classes)})"
+            )
+        if isinstance(it, Request):
+            return it
+        return Request(
+            id=int(it.id), prompt=np.asarray(it.prompt, np.int32),
+            max_new_tokens=int(it.max_new_tokens), arrival=int(it.arrival),
+            traffic_class=cls,
+        )
+
+    def _family_key(self, prompt: np.ndarray) -> bytes | None:
+        """The sticky-affinity key: the prompt's leading
+        ``affinity_window`` tokens, never the whole prompt (two family
+        members differ in their tails), page-ALIGNED on paged engines
+        so the key covers exactly the pages a hit would share."""
+        w = self.config.affinity_window
+        eng = self.engines[0]
+        if eng.paged and w >= eng.page_size:
+            w -= w % eng.page_size
+        k = min(int(prompt.shape[0]) - 1, w)
+        if k < 2:
+            return None  # BOS alone is every prompt's prefix — no family
+        return np.asarray(prompt[:k], np.int32).tobytes()
+
+    def _place(self, req: Request, pressures) -> tuple[int, str]:
+        """Choose a replica: deepest live prefix coverage first (pure
+        probes), then the sticky family map, then least load — backlog
+        (occupied + every queued request), free pages as the
+        tie-breaker, replica id as the deterministic last word."""
+        key = None
+        if self.config.prefix_affinity:
+            depths = []
+            for eng in self.engines:
+                d = 0
+                if eng.prefix is not None:
+                    _, d = eng.prefix.match(req.prompt)
+                depths.append(int(d))
+            best = max(depths)
+            if best >= MIN_PREFIX_HIT:
+                return depths.index(best), "affinity"
+            key = self._family_key(req.prompt)
+            if key is not None and key in self._sticky:
+                return self._sticky[key], "affinity"
+        k = min(
+            range(len(self.scheds)),
+            key=lambda i: (
+                pressures[i].occupied_slots + pressures[i].pending_total,
+                -pressures[i].pages_available,
+                i,
+            ),
+        )
+        return k, "load"
+
+    def _route(self, req: Request, t: int, done: dict, cls_of: dict,
+               counters: dict) -> None:
+        cls = self.classes[req.traffic_class]
+        cls_of[req.id] = cls.name
+        pressures = [s.pressure() for s in self.scheds]
+        if self.config.shed_threshold is not None:
+            shed_at = self.config.shed_threshold - cls.margin
+            backlog = min(p.occupied_slots + p.pending_total
+                          for p in pressures)
+            if backlog >= shed_at:
+                # Router-level priority shed: no replica has headroom
+                # for this class's margin — refuse at the door, decided
+                # once, counted per class. (The replica scheduler's own
+                # threshold still backstops whatever was admitted.)
+                done[req.id] = Completion(
+                    id=req.id,
+                    prompt_len=int(np.asarray(req.prompt).shape[0]),
+                    tokens=[], admitted_step=-1, finished_step=t,
+                    status="shed",
+                )
+                counters["router_sheds"] += 1
+                if self.tracer:
+                    self.tracer.event("router_shed", req=int(req.id),
+                                      tick=t, cls=cls.name,
+                                      backlog=int(backlog))
+                if self.registry is not None:
+                    self.registry.counter("router_shed_total").inc(
+                        **{"class": cls.name}
+                    )
+                return
+        replica, reason = self._place(req, pressures)
+        counters["placements"][req.id] = replica
+        counters["affinity" if reason == "affinity" else "load"] += 1
+        if self.config.prefix_affinity:
+            key = self._family_key(req.prompt)
+            if key is not None:
+                # The family now lives where this request went —
+                # co-arriving siblings follow before registration lands.
+                self._sticky[key] = replica
+        if self.tracer:
+            self.tracer.event("route", req=int(req.id), tick=t,
+                              replica=replica, reason=reason,
+                              cls=cls.name)
+        if self.registry is not None:
+            self.registry.counter("router_requests_total").inc(
+                **{"class": cls.name}
+            )
+            self.registry.counter(
+                "router_affinity_placements_total" if reason == "affinity"
+                else "router_load_placements_total"
+            ).inc()
+        self.scheds[replica].submit(req)
+
+    # -- the replica-stepping loop -----------------------------------------
+
+    def run(self, items) -> tuple[dict[int, Completion], RouterStats]:
+        """Serve an open-loop stream to completion. Each global tick:
+        route every request whose arrival has come (shed or submit),
+        then tick every non-idle replica once, in replica order. The
+        loop fast-forwards over globally idle gaps exactly like the
+        scheduler's own tick loop."""
+        reqs = sorted((self._to_request(it) for it in items),
+                      key=lambda r: (r.arrival, r.id))
+        ids = [r.id for r in reqs]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate request ids in {ids}")
+        done: dict[int, Completion] = {}
+        cls_of: dict[int, str] = {}
+        counters = {"placements": {}, "affinity": 0, "load": 0,
+                    "router_sheds": 0}
+        # THIS run's slice of the (possibly shared, possibly reused)
+        # tracer: stats derive from records emitted after this point,
+        # so a reset-and-rerun router never folds a previous run's
+        # timestamps into the new run's SLO samples (a repeated request
+        # id would otherwise pair run 1's `eligible` with run 2's
+        # `first_token` — a TTFT spanning the inter-run gap).
+        rec_start = len(self.tracer.records)
+        for sched in self.scheds:
+            sched.begin()
+        t = 0
+        i = 0
+        ticks = 0
+        try:
+            while i < len(reqs) or any(not s.idle for s in self.scheds):
+                while i < len(reqs) and reqs[i].arrival <= t:
+                    self._route(reqs[i], t, done, cls_of, counters)
+                    i += 1
+                for k, sched in enumerate(self.scheds):
+                    if not sched.idle:
+                        sched.tick()
+                if self.registry is not None:
+                    for k, sched in enumerate(self.scheds):
+                        p = sched.pressure()
+                        self.registry.gauge(
+                            "router_replica_outstanding"
+                        ).set(p.occupied_slots + p.pending_total,
+                              replica=k)
+                ticks += 1
+                t += 1
+                if i < len(reqs) and all(s.idle for s in self.scheds):
+                    t = max(t, reqs[i].arrival)
+            per_replica = [sched.collect() for sched in self.scheds]
+        finally:
+            for sched in self.scheds:
+                sched.release()
+        for rd, _ in per_replica:
+            done.update(rd)
+        stats = self._stats(done, cls_of, counters,
+                            [s for _, s in per_replica], ticks,
+                            self.tracer.records[rec_start:])
+        return done, stats
+
+    def _stats(self, done, cls_of, counters, replica_stats, ticks,
+               records) -> RouterStats:
+        from ..utils.metrics import StepStats
+
+        samples = request_slo_samples(records)
+        per_class: dict[str, ClassReport] = {}
+        for name, spec in self.classes.items():
+            members = [rid for rid, c in cls_of.items() if c == name]
+            if not members:
+                continue
+            statuses = [done[rid].status for rid in members]
+            ttfts = [samples[rid][0] for rid in members if rid in samples]
+            itls = [g for rid in members if rid in samples
+                    for g in samples[rid][1]]
+            ttft_ok = sum(1 for v in ttfts if v <= spec.ttft_slo_s)
+            itl_ok = sum(1 for v in itls if v <= spec.itl_slo_s)
+            per_class[name] = ClassReport(
+                name=name,
+                requests=len(members),
+                ok=statuses.count("ok"),
+                shed=statuses.count("shed"),
+                deadline_exceeded=statuses.count("deadline_exceeded"),
+                ttft=StepStats.from_times(ttfts),
+                itl=StepStats.from_times(itls),
+                # Sheds/expiries produced no first token and count as
+                # misses: attained = served-within-target / ALL requests.
+                ttft_slo_attained=(ttft_ok / len(members)) if members
+                else 1.0,
+                # No ITL samples is vacuous attainment ONLY when the
+                # class actually served something (1-token requests
+                # legitimately have no inter-token gaps); a class with
+                # zero completions did not attain anything.
+                itl_slo_attained=(itl_ok / len(itls)) if itls
+                else (1.0 if statuses.count("ok") else 0.0),
+            )
+            if self.registry is not None:
+                self.registry.histogram("router_ttft_seconds").observe_many(
+                    ttfts, **{"class": name}
+                )
+                self.registry.histogram("router_itl_seconds").observe_many(
+                    itls, **{"class": name}
+                )
+                for status in ("ok", "shed", "deadline_exceeded"):
+                    n = statuses.count(status)
+                    if n:
+                        self.registry.counter(
+                            "router_completions_total"
+                        ).inc(n, **{"class": name, "status": status})
+        return RouterStats(
+            per_class=per_class,
+            placements=dict(counters["placements"]),
+            affinity_placements=counters["affinity"],
+            load_placements=counters["load"],
+            router_sheds=counters["router_sheds"],
+            ticks=ticks,
+            replica=list(replica_stats),
+        )
+
+
+# -- CLI spec grammars --------------------------------------------------------
+
+
+def parse_traffic_spec(spec: str) -> dict:
+    """``--traffic`` grammar -> :func:`data.lm.synthesize_mixed_traffic`
+    kwargs. Segments are ``;``-separated: global keys
+    (``horizon=N``, ``seed=N``, ``max_requests=N``,
+    ``burst=START:LEN:MULT[:CLASS]``, ``diurnal=AMPLITUDE:PERIOD``) or
+    class segments ``NAME:key=val,...`` with keys ``rate`` (per-tick
+    Poisson mean), ``pmin``/``pmax`` (prompt length bounds), ``new``
+    (max_new_tokens), ``families``/``fprefix`` (shared-prefix families).
+    Example::
+
+        horizon=48;chat:rate=0.6,pmin=8,pmax=24,new=8,families=4,\
+fprefix=6;bulk:rate=0.3,pmin=8,pmax=32,new=16
+    """
+    kw: dict = {"classes": {}}
+    key_map = {"rate": ("rate", float), "pmin": ("prompt_min", int),
+               "pmax": ("prompt_max", int), "new": ("max_new_tokens", int),
+               "families": ("families", int),
+               "fprefix": ("family_prefix_len", int)}
+    for seg in spec.split(";"):
+        seg = seg.strip()
+        if not seg:
+            continue
+        head, _, body = seg.partition(":")
+        head = head.strip()
+        if "=" in head:  # a global key=value segment
+            key, _, val = head.partition("=")
+            key = key.strip()
+            if key in ("horizon", "seed", "max_requests"):
+                kw[key] = int(val)
+            elif key == "burst":
+                parts = [p.strip() for p in (val + ":" + body).split(":")
+                         if p.strip()] if body else \
+                    [p.strip() for p in val.split(":")]
+                if not 3 <= len(parts) <= 4:
+                    raise ValueError(
+                        f"burst takes START:LEN:MULT[:CLASS], got {seg!r}"
+                    )
+                kw["burst"] = (int(parts[0]), int(parts[1]),
+                               float(parts[2]),
+                               *([parts[3]] if len(parts) == 4 else []))
+            elif key == "diurnal":
+                parts = [p.strip() for p in (val + ":" + body).split(":")
+                         if p.strip()] if body else \
+                    [p.strip() for p in val.split(":")]
+                if len(parts) != 2:
+                    raise ValueError(
+                        f"diurnal takes AMPLITUDE:PERIOD, got {seg!r}"
+                    )
+                kw["diurnal_amplitude"] = float(parts[0])
+                kw["diurnal_period"] = int(parts[1])
+            else:
+                raise ValueError(
+                    f"unknown traffic key {key!r} in segment {seg!r}"
+                )
+            continue
+        if not body:
+            raise ValueError(
+                f"class segment {seg!r} needs NAME:key=val[,key=val...]"
+            )
+        cls: dict = {}
+        for kv in body.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, eq, val = kv.partition("=")
+            key = key.strip()
+            if not eq or key not in key_map:
+                raise ValueError(
+                    f"class {head!r}: bad key {kv!r} "
+                    f"(valid: {sorted(key_map)})"
+                )
+            dest, conv = key_map[key]
+            cls[dest] = conv(val)
+        kw["classes"][head] = cls
+    if not kw["classes"]:
+        raise ValueError(
+            f"--traffic spec {spec!r} declares no traffic classes"
+        )
+    return kw
+
+
+def parse_slo_spec(spec: str, class_names) -> tuple[ClassSpec, ...]:
+    """``--slo`` grammar -> :class:`ClassSpec` tuple for the given
+    traffic classes. Segments ``NAME:key=val,...`` with keys ``ttft``
+    (seconds), ``itl`` (seconds), ``priority`` (0 = most protected),
+    ``margin`` (shed margin; default = priority). Classes not named get
+    defaults from :data:`DEFAULT_CLASS_SPECS` (matching by name) or a
+    zero-priority, no-target spec. Example::
+
+        chat:ttft=0.5,itl=0.1,priority=0;bulk:ttft=60,priority=2
+    """
+    overrides: dict[str, dict] = {}
+    for seg in spec.split(";") if spec else []:
+        seg = seg.strip()
+        if not seg:
+            continue
+        name, colon, body = seg.partition(":")
+        name = name.strip()
+        if not colon or not body:
+            raise ValueError(
+                f"slo segment {seg!r} needs NAME:key=val[,key=val...]"
+            )
+        if name not in class_names:
+            raise ValueError(
+                f"--slo names unknown class {name!r} "
+                f"(traffic classes: {sorted(class_names)})"
+            )
+        kv: dict = {}
+        for part in body.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, eq, val = part.partition("=")
+            key = key.strip()
+            if not eq or key not in ("ttft", "itl", "priority", "margin"):
+                raise ValueError(
+                    f"class {name!r}: bad slo key {part!r} (valid: ttft, "
+                    "itl, priority, margin)"
+                )
+            if key == "ttft":
+                kv["ttft_slo_s"] = float(val)
+            elif key == "itl":
+                kv["itl_slo_s"] = float(val)
+            elif key == "priority":
+                kv["priority"] = int(val)
+            else:
+                kv["shed_margin"] = int(val)
+        overrides[name] = kv
+    defaults = {c.name: c for c in DEFAULT_CLASS_SPECS}
+    out = []
+    for name in sorted(class_names):
+        base = defaults.get(name, ClassSpec(name))
+        out.append(dataclasses.replace(base, name=name,
+                                       **overrides.get(name, {})))
+    return tuple(out)

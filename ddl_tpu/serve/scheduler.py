@@ -123,7 +123,11 @@ class Request:
     None inherits the scheduler's defaults). Expiry EVICTS the request
     — slot freed, pinned prefix refs released — and returns a
     ``Completion(status="deadline_exceeded")`` with whatever tokens
-    were generated, instead of holding a slot forever."""
+    were generated, instead of holding a slot forever.
+
+    ``traffic_class`` (ISSUE 8) names the request's SLO class for the
+    multi-replica router (``serve.router``) — the scheduler itself
+    ignores it; per-class accounting lives one layer up."""
 
     id: int
     prompt: np.ndarray  # int32 [p], p >= 1
@@ -131,6 +135,7 @@ class Request:
     arrival: int = 0
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
+    traffic_class: str = "default"
 
 
 @dataclasses.dataclass
@@ -191,9 +196,81 @@ class ServeStats:
                 if self.prefix_lookups else 0.0)
 
 
+@dataclasses.dataclass(frozen=True)
+class Pressure:
+    """Non-destructive scheduler load probe (ISSUE 8 satellite): the
+    numbers a router needs to place traffic, read through one method
+    instead of reaching into run-loop state. Field-for-field equal to
+    the registry gauges the tick loop publishes (pinned in
+    tests/test_serve.py): ``occupied_slots`` ≡ ``serve_occupied_slots``,
+    ``active_slots`` ≡ ``serve_active_slots``, ``pages_free`` ≡
+    ``serve_kv_pages_free`` (0 on the contiguous layout),
+    ``prefix_entries`` ≡ ``serve_prefix_pool_entries``.
+    ``waiting_eligible`` counts arrivals due at the NEXT tick's clock —
+    the routing-relevant reading — which equals the just-published
+    ``serve_queue_depth`` gauge (stamped with the finished tick's
+    clock) whenever every pending arrival is already due; with
+    still-future arrivals the probe runs one step ahead of the gauge.
+    ``pages_available`` additionally
+    subtracts admission reservations — the true headroom the paged
+    admission path gates on (no gauge twin; reservations are promised
+    capacity, not free capacity). Between runs every queue/slot field
+    reads 0."""
+
+    occupied_slots: int
+    active_slots: int
+    waiting_eligible: int  # submitted, arrival reached, not yet admitted
+    pending_total: int  # submitted and not yet admitted, future arrivals too
+    pages_free: int  # paged pool only; 0 contiguous
+    pages_available: int  # pages_free minus admission reservations
+    prefix_entries: int
+
+    @property
+    def outstanding(self) -> int:
+        """Occupied slots + waiting eligibles — the same quantity the
+        shed threshold compares against (ISSUE 6)."""
+        return self.occupied_slots + self.waiting_eligible
+
+
+class _RunState:
+    """Everything one :meth:`Scheduler.run` used to keep in locals,
+    lifted into an object so a run can be driven EXTERNALLY tick by
+    tick (``begin``/``submit``/``tick``/``collect`` — the router's
+    replica-stepping loop, ISSUE 8) and probed mid-flight
+    (:meth:`Scheduler.pressure`)."""
+
+    def __init__(self, slots: int):
+        self.pending: collections.deque = collections.deque()
+        self.occupant: list[Request | None] = [None] * slots
+        self.active = np.zeros(slots, bool)  # decoding (prefill complete)
+        self.lengths = np.zeros(slots, np.int32)  # tokens resident
+        self.last_tokens = np.zeros(slots, np.int32)  # sampled, unappended
+        self.req_ids = np.zeros(slots, np.int32)
+        self.generated: list[list[int]] = [[] for _ in range(slots)]
+        self.admitted_at = np.zeros(slots, np.int64)
+        self.prefilled = np.zeros(slots, np.int64)  # prompt tokens in cache
+        self.store_after = [False] * slots  # register prompt when done
+        self.held_entry = [-1] * slots  # pinned pool entry behind admission
+        self.done: dict[int, Completion] = {}
+        self.prefill_timer = StepTimer()
+        self.decode_timer = StepTimer()
+        self.eligible_wall: dict[int, float] = {}
+        self.ttfts: list[float] = []
+        self.itls: list[float] = []
+        self.lookups = self.hits = self.saved = 0
+        self.last_decode_done: float | None = None
+        self.step = 0
+        self.deadlines_on = False
+        self.seen_ids: set[int] = set()
+
+
 class Scheduler:
     """Continuous-batching driver. One instance per engine; ``run`` is
-    synchronous and returns when every request has completed.
+    synchronous and returns when every request has completed. For
+    externally-timed driving (the multi-replica router, ISSUE 8) the
+    same run decomposes into ``begin`` / ``submit`` / ``tick`` /
+    ``collect`` with ``pressure()`` as the non-destructive load probe —
+    ``run`` is literally that sequence, so the two forms cannot drift.
     ``allow_window=True`` admits requests whose ``prompt +
     max_new_tokens`` exceeds the cache capacity — the ring wraps and
     attention degrades to an EXACT sliding window over the last
@@ -250,6 +327,10 @@ class Scheduler:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = registry
         self.metrics_writer = metrics_writer
+        # Externally-driven run state (ISSUE 8): armed by begin(),
+        # advanced by tick(), finalized by collect()/release(). run()
+        # is sugar over the same four primitives.
+        self._st: _RunState | None = None
 
     def warmup(self, requests) -> None:
         """Compile the decode program and every prefill bucket / prefix
@@ -443,516 +524,664 @@ class Scheduler:
         total = r.deadline_s if r.deadline_s is not None else self.deadline_s
         return ttft, total
 
+    # -- externally-driven run form (ISSUE 8) ------------------------------
+    #
+    # `run` is sugar over four primitives so a front door can own the
+    # clock: `begin()` arms a fresh run, `submit()` validates and
+    # enqueues (any time while armed — externally-timed submission),
+    # `tick()` advances exactly one scheduler step, `collect()`
+    # finalizes and returns the same (completions, stats) `run`
+    # returns. The multi-replica router (serve.router) interleaves
+    # `tick()` across replicas round-robin and reads `pressure()` to
+    # place traffic; because an idle tick makes NO device calls, a
+    # 1-replica externally-driven run is bit-identical to `run` on the
+    # same request stream (pinned in tests/test_router.py).
+
+    def begin(self) -> None:
+        """Arm an externally-driven run. One run at a time per
+        scheduler — ``collect`` (or ``release``, on an abort path)
+        disarms it."""
+        if self._st is not None:
+            raise RuntimeError(
+                "a run is already armed on this scheduler; collect() or "
+                "release() it before begin()"
+            )
+        st = _RunState(self.engine.config.slots)
+        st.deadlines_on = (self.ttft_deadline_s is not None
+                           or self.deadline_s is not None)
+        self._st = st
+
+    def submit(self, r: Request) -> None:
+        """Validate and enqueue one request into the armed run. The
+        queue stays (arrival, id)-sorted whatever the submission order
+        (the fast path — the router submits streams pre-sorted — is a
+        plain append)."""
+        st = self._require_run()
+        self._validate(r)
+        if r.id in st.seen_ids:
+            raise ValueError(f"duplicate request id {r.id}")
+        st.seen_ids.add(r.id)
+        st.deadlines_on = st.deadlines_on or (
+            r.ttft_deadline_s is not None or r.deadline_s is not None
+        )
+        last = st.pending[-1] if st.pending else None
+        if last is not None and (r.arrival, r.id) < (last.arrival, last.id):
+            st.pending = collections.deque(
+                sorted([*st.pending, r], key=lambda q: (q.arrival, q.id))
+            )
+        else:
+            st.pending.append(r)
+        if self.tracer:
+            self.tracer.event(
+                "submit", t=time.perf_counter(), req=int(r.id),
+                prompt_len=int(np.asarray(r.prompt).shape[0]),
+                arrival=int(r.arrival),
+                max_new_tokens=int(r.max_new_tokens),
+            )
+
+    def _require_run(self) -> _RunState:
+        if self._st is None:
+            raise RuntimeError("no armed run: call begin() first")
+        return self._st
+
+    @property
+    def idle(self) -> bool:
+        """True when a tick would have nothing to do — no occupant and
+        nothing pending. A request pending at a FUTURE arrival still
+        counts as work (the tick loop fast-forwards to it)."""
+        st = self._st
+        if st is None:
+            return True
+        return not st.pending and all(o is None for o in st.occupant)
+
+    def pressure(self) -> Pressure:
+        """Non-destructive load probe (see :class:`Pressure`): safe at
+        any time, armed run or not, and never perturbs queue, LRU or
+        page state — the router's placement signal."""
+        eng = self.engine
+        occupied = active = waiting = total = 0
+        st = self._st
+        if st is not None:
+            occupied = sum(o is not None for o in st.occupant)
+            active = int(st.active.sum())
+            for q in st.pending:  # (arrival, id)-sorted: early break
+                if q.arrival > st.step:
+                    break
+                waiting += 1
+            total = len(st.pending)
+        return Pressure(
+            occupied_slots=occupied,
+            active_slots=active,
+            waiting_eligible=waiting,
+            pending_total=total,
+            pages_free=int(eng.pages.free) if eng.paged else 0,
+            pages_available=int(eng.pages.available) if eng.paged else 0,
+            prefix_entries=len(eng.prefix) if eng.prefix is not None else 0,
+        )
+
+    def collect(self) -> tuple[dict[int, Completion], ServeStats]:
+        """Finalize the armed run: flush the run-total counters into
+        the registry and return ``(completions, stats)`` exactly as
+        :meth:`run` would. Disarms the run."""
+        st = self._require_run()
+        latency = st.decode_timer.stats()
+        if self.registry is not None:
+            reg = self.registry
+            reg.counter("serve_prefix_lookups_total").inc(st.lookups)
+            reg.counter("serve_prefix_hits_total").inc(st.hits)
+            reg.counter("serve_prefill_tokens_saved_total").inc(st.saved)
+        stats = ServeStats(
+            prefill_tokens=st.prefill_timer.total_images,
+            prefill_s=st.prefill_timer.total_s,
+            decode_tokens=st.decode_timer.total_images,
+            decode_steps=latency.steps,
+            decode_s=st.decode_timer.total_s,
+            slots=self.engine.config.slots,
+            latency=latency,
+            ttft=StepStats.from_times(st.ttfts),
+            itl=StepStats.from_times(st.itls),
+            prefix_lookups=st.lookups,
+            prefix_hits=st.hits,
+            prefill_tokens_saved=st.saved,
+        )
+        self._st = None
+        return st.done, stats
+
+    def release(self) -> None:
+        """Disarm an aborted run, dropping anything it still pins. An
+        exception mid-run (device failure, KeyboardInterrupt) must not
+        leave pool entries pinned forever on an engine that outlives
+        the run — orphaned refs would block every future eviction AND
+        registration, and (paged) leaked page references would shrink
+        the pool for every future run. No-op after a clean ``collect``
+        (normal completion already released everything in
+        ``_finish``)."""
+        st = self._st
+        if st is None:
+            return
+        eng = self.engine
+        for s in range(eng.config.slots):
+            if st.held_entry[s] >= 0:
+                eng.prefix_release(st.held_entry[s])
+                st.held_entry[s] = -1
+            if eng.paged and st.occupant[s] is not None:
+                eng.release_slot(s)
+        self._st = None
+
     def run(self, requests) -> tuple[dict[int, Completion], ServeStats]:
         """Serve ``requests`` to completion. Admission order is (arrival,
-        id) — a deterministic queue, so runs are reproducible."""
+        id) — a deterministic queue, so runs are reproducible. Every
+        request is validated BEFORE any is enqueued, so one malformed
+        request fails the whole call with no partial state."""
         for r in requests:
             self._validate(r)
         ids = [r.id for r in requests]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate request ids in {ids}")
-        if self.tracer:
-            t_sub = time.perf_counter()
-            for r in requests:
-                self.tracer.event(
-                    "submit", t=t_sub, req=int(r.id),
-                    prompt_len=int(np.asarray(r.prompt).shape[0]),
-                    arrival=int(r.arrival),
-                    max_new_tokens=int(r.max_new_tokens),
-                )
-        eng = self.engine
-        S = eng.config.slots
-        pending = collections.deque(
-            sorted(requests, key=lambda r: (r.arrival, r.id))
-        )
-        # Host-side slot state, passed to the engine every decode step.
-        active = np.zeros(S, bool)  # decoding (prefill complete)
-        lengths = np.zeros(S, np.int32)  # tokens resident in the cache
-        last_tokens = np.zeros(S, np.int32)  # sampled, not yet appended
-        req_ids = np.zeros(S, np.int32)
-        occupant: list[Request | None] = [None] * S
-        generated: list[list[int]] = [[] for _ in range(S)]
-        admitted_at = np.zeros(S, np.int64)
-        prefilled = np.zeros(S, np.int64)  # prompt tokens already in cache
-        store_after = [False] * S  # register prompt in the pool when done
-        held_entry = [-1] * S  # pinned pool entry backing this admission
-
-        done: dict[int, Completion] = {}
-        prefill_timer = StepTimer()
-        decode_timer = StepTimer()
-        eligible_wall: dict[int, float] = {}
-        ttfts: list[float] = []
-        itls: list[float] = []
-
+        self.begin()
         try:
-            return self._drive(
-                requests, pending, occupant, active, lengths,
-                last_tokens, req_ids, generated, admitted_at, prefilled,
-                store_after, held_entry, done, prefill_timer,
-                decode_timer, eligible_wall, ttfts, itls,
-            )
+            for r in sorted(requests, key=lambda r: (r.arrival, r.id)):
+                self.submit(r)
+            while not self.idle:
+                self.tick()
+            return self.collect()
         finally:
-            # An exception mid-run (device failure, KeyboardInterrupt)
-            # must not leave pool entries pinned forever on an engine
-            # that outlives this run — orphaned refs would block every
-            # future eviction AND registration, and (paged) leaked page
-            # references would shrink the pool for every future run.
-            # Normal completion has already released everything
-            # (finish()), so this no-ops.
-            for s in range(S):
-                if held_entry[s] >= 0:
-                    eng.prefix_release(held_entry[s])
-                    held_entry[s] = -1
-                if eng.paged and occupant[s] is not None:
-                    eng.release_slot(s)
+            self.release()
 
-    def _drive(self, requests, pending, occupant, active, lengths,
-               last_tokens, req_ids, generated, admitted_at, prefilled,
-               store_after, held_entry, done, prefill_timer,
-               decode_timer, eligible_wall, ttfts, itls):
-        """The tick loop behind :meth:`run` (split out so ``run`` can
-        guarantee pin release on ANY exit path)."""
+    # -- the tick body ------------------------------------------------------
+
+    def _finish(self, st: _RunState, s: int, status: str = "ok") -> None:
+        eng = self.engine
+        tr = self.tracer
+        reg = self.registry
+        r = st.occupant[s]
+        st.done[r.id] = Completion(
+            id=r.id,
+            prompt_len=int(np.asarray(r.prompt).shape[0]),
+            tokens=list(st.generated[s]),
+            admitted_step=int(st.admitted_at[s]),
+            finished_step=st.step,
+            status=status,
+        )
+        st.active[s] = False
+        st.occupant[s] = None
+        pages_held = int(eng.table_len[s]) if eng.paged else 0
+        if eng.paged:
+            # Page references drop (shared prefix pages survive on
+            # their entry's reference) and any unused reservation
+            # returns — eviction and completion are the same
+            # bookkeeping, so a deadline eviction can never leak
+            # pool capacity.
+            eng.release_slot(s)
+        if st.held_entry[s] >= 0:
+            # Deadline eviction releases pinned prefix refs exactly
+            # like normal completion — an evicted request can never
+            # wedge the pool.
+            eng.prefix_release(st.held_entry[s])
+            st.held_entry[s] = -1
+        if tr:
+            # Completion IS the eviction: the slot frees here.
+            # kv_pages_held records the request's peak residency at
+            # completion (ISSUE 7 satellite — 0 on the contiguous
+            # layout, where residency is the fixed capacity).
+            tr.event("complete", req=int(r.id), slot=s, step=st.step,
+                     tokens=len(st.generated[s]), status=status,
+                     kv_pages_held=pages_held)
+        if reg is not None:
+            if status == "deadline_exceeded":
+                reg.counter("serve_deadline_exceeded_total").inc()
+            else:
+                reg.counter("serve_requests_completed_total").inc()
+
+    def _expire_queued(self, st: _RunState, r: Request, status: str) -> None:
+        """Remove a never-admitted request from the queue with a
+        structured outcome (shed at admission, or expired while
+        waiting) — it held no slot and pinned nothing."""
+        st.pending.remove(r)
+        st.done[r.id] = Completion(
+            id=r.id,
+            prompt_len=int(np.asarray(r.prompt).shape[0]),
+            tokens=[], admitted_step=-1, finished_step=st.step,
+            status=status,
+        )
+        if self.tracer:
+            self.tracer.event(status, req=int(r.id), step=st.step)
+        if self.registry is not None:
+            self.registry.counter(
+                "serve_shed_total" if status == "shed"
+                else "serve_deadline_exceeded_total"
+            ).inc()
+
+    def _finished(self, st: _RunState, s: int, token: int) -> bool:
+        return (len(st.generated[s]) >= st.occupant[s].max_new_tokens
+                or (self.eos_id is not None and token == self.eos_id))
+
+    def tick(self) -> None:
+        """One scheduler step of the armed run: stamp eligibility /
+        shed / expire, admit into free slots, prefill under the chunk
+        budget, one batched decode, per-tick telemetry — exactly the
+        loop body ``run`` iterates until idle. An idle tick (nothing
+        eligible, nothing active) makes NO device calls, which is what
+        lets an external driver insert clock-alignment ticks without
+        perturbing the device-call sequence."""
+        st = self._require_run()
         eng = self.engine
         cfg = eng.config
         S = cfg.slots
         tr = self.tracer
         reg = self.registry
+        inj = self.injector
         chunk = cfg.prefill_chunk
         # Unset budget defaults to ONE chunk per tick — maximum decode
         # interleaving; chunking with an unmetered tick would run every
         # chunk back-to-back and reintroduce the whole-prompt stall.
         budget0 = cfg.prefill_budget or chunk
-        lookups = hits = saved = 0
-        last_decode_done: float | None = None
-        step = 0
-        inj = self.injector
-        # Deadline machinery only arms when some deadline can apply —
-        # a bare Scheduler pays none of its clock reads or sweeps.
-        deadlines_on = (
-            self.ttft_deadline_s is not None or self.deadline_s is not None
-            or any(r.ttft_deadline_s is not None or r.deadline_s is not None
-                   for r in requests)
-        )
-
-        def finish(s: int, status: str = "ok") -> None:
-            r = occupant[s]
-            done[r.id] = Completion(
-                id=r.id,
-                prompt_len=int(np.asarray(r.prompt).shape[0]),
-                tokens=list(generated[s]),
-                admitted_step=int(admitted_at[s]),
-                finished_step=step,
-                status=status,
+        step = st.step
+        # TTFT clock starts the first tick a request is eligible
+        # (arrival reached), whether or not a slot is free — the
+        # queueing delay is part of time-to-first-token.
+        now = time.perf_counter()
+        # Admission shedding decides ONCE, at first eligibility:
+        # outstanding work (occupied slots + already-waiting
+        # eligibles) at or past the threshold refuses the newcomer
+        # with a structured "shed" — overload degrades the newest
+        # arrivals instead of collapsing every admitted request's
+        # ITL.
+        outstanding = -1
+        if self.shed_threshold is not None:
+            outstanding = sum(o is not None for o in st.occupant) + sum(
+                1 for q in st.pending
+                if q.arrival <= step and q.id in st.eligible_wall
             )
-            active[s] = False
-            occupant[s] = None
-            pages_held = int(eng.table_len[s]) if eng.paged else 0
-            if eng.paged:
-                # Page references drop (shared prefix pages survive on
-                # their entry's reference) and any unused reservation
-                # returns — eviction and completion are the same
-                # bookkeeping, so a deadline eviction can never leak
-                # pool capacity.
-                eng.release_slot(s)
-            if held_entry[s] >= 0:
-                # Deadline eviction releases pinned prefix refs exactly
-                # like normal completion — an evicted request can never
-                # wedge the pool.
-                eng.prefix_release(held_entry[s])
-                held_entry[s] = -1
-            if tr:
-                # Completion IS the eviction: the slot frees here.
-                # kv_pages_held records the request's peak residency at
-                # completion (ISSUE 7 satellite — 0 on the contiguous
-                # layout, where residency is the fixed capacity).
-                tr.event("complete", req=int(r.id), slot=s, step=step,
-                         tokens=len(generated[s]), status=status,
-                         kv_pages_held=pages_held)
-            if reg is not None:
-                if status == "deadline_exceeded":
-                    reg.counter("serve_deadline_exceeded_total").inc()
-                else:
-                    reg.counter("serve_requests_completed_total").inc()
-
-        def expire_queued(r: Request, status: str) -> None:
-            """Remove a never-admitted request from the queue with a
-            structured outcome (shed at admission, or expired while
-            waiting) — it held no slot and pinned nothing."""
-            pending.remove(r)
-            done[r.id] = Completion(
-                id=r.id,
-                prompt_len=int(np.asarray(r.prompt).shape[0]),
-                tokens=[], admitted_step=-1, finished_step=step,
-                status=status,
-            )
-            if tr:
-                tr.event(status, req=int(r.id), step=step)
-            if reg is not None:
-                reg.counter(
-                    "serve_shed_total" if status == "shed"
-                    else "serve_deadline_exceeded_total"
-                ).inc()
-
-        def finished(s: int, token: int) -> bool:
-            return (len(generated[s]) >= occupant[s].max_new_tokens
-                    or (self.eos_id is not None and token == self.eos_id))
-
-        while pending or any(o is not None for o in occupant):
-            # TTFT clock starts the first tick a request is eligible
-            # (arrival reached), whether or not a slot is free — the
-            # queueing delay is part of time-to-first-token.
-            now = time.perf_counter()
-            # Admission shedding decides ONCE, at first eligibility:
-            # outstanding work (occupied slots + already-waiting
-            # eligibles) at or past the threshold refuses the newcomer
-            # with a structured "shed" — overload degrades the newest
-            # arrivals instead of collapsing every admitted request's
-            # ITL.
-            outstanding = -1
-            if self.shed_threshold is not None:
-                outstanding = sum(o is not None for o in occupant) + sum(
-                    1 for q in pending
-                    if q.arrival <= step and q.id in eligible_wall
-                )
-            shed_now = []
-            for r in pending:
+        shed_now = []
+        for r in st.pending:
+            if r.arrival > step:
+                break  # pending is (arrival, id)-sorted
+            if r.id not in st.eligible_wall:
+                if self.shed_threshold is not None \
+                        and outstanding >= self.shed_threshold:
+                    shed_now.append(r)
+                    continue
+                st.eligible_wall[r.id] = now
+                outstanding += 1
+                if tr:
+                    # Stamped with the SAME `now` the TTFT clock
+                    # starts from — the derived-TTFT exactness pin.
+                    tr.event("eligible", t=now, req=int(r.id), step=step)
+        for r in shed_now:
+            self._expire_queued(st, r, "shed")
+        if st.deadlines_on:
+            # Expiry sweep: waiting requests past any applicable
+            # deadline never admit; occupied slots past theirs evict
+            # (partial tokens kept, prefix pins released in _finish).
+            expired = []
+            for r in st.pending:
                 if r.arrival > step:
-                    break  # pending is (arrival, id)-sorted
-                if r.id not in eligible_wall:
-                    if self.shed_threshold is not None \
-                            and outstanding >= self.shed_threshold:
-                        shed_now.append(r)
-                        continue
-                    eligible_wall[r.id] = now
-                    outstanding += 1
-                    if tr:
-                        # Stamped with the SAME `now` the TTFT clock
-                        # starts from — the derived-TTFT exactness pin.
-                        tr.event("eligible", t=now, req=int(r.id), step=step)
-            for r in shed_now:
-                expire_queued(r, "shed")
-            if deadlines_on:
-                # Expiry sweep: waiting requests past any applicable
-                # deadline never admit; occupied slots past theirs evict
-                # (partial tokens kept, prefix pins released in finish).
-                expired = []
-                for r in pending:
-                    if r.arrival > step:
+                    break
+                t0 = st.eligible_wall.get(r.id)
+                if t0 is None:
+                    continue
+                lims = [v for v in self._deadline_for(r) if v is not None]
+                if lims and now - t0 > min(lims):
+                    expired.append(r)
+            for r in expired:
+                self._expire_queued(st, r, "deadline_exceeded")
+            for s in range(S):
+                r = st.occupant[s]
+                if r is None:
+                    continue
+                ttft, total = self._deadline_for(r)
+                # Pre-first-token both deadlines bound the wait;
+                # once decoding, only the total deadline applies.
+                lims = [v for v in ((ttft, total) if not st.active[s]
+                                    else (total,)) if v is not None]
+                if lims and now - st.eligible_wall[r.id] > min(lims):
+                    self._finish(st, s, status="deadline_exceeded")
+        # Admit: claim every free slot whose turn has come. With the
+        # prefix cache, admission itself is only the (optional) row
+        # copy (contiguous) or table mapping (paged) — prompt
+        # compute happens in the prefill phase below. On the paged
+        # pool, admission FIRST checks "enough free pages" for the
+        # request's worst case (prompt + max_new, minus the full
+        # pages a prefix hit shares) and RESERVES them — capacity
+        # pools across slots instead of a per-slot worst-case ring.
+        # The queue stays strictly FIFO: when the head cannot fit,
+        # nothing behind it admits either (deterministic, and no
+        # small-request starvation of the long head).
+        for s in range(S):
+            if st.occupant[s] is not None or not st.pending \
+                    or st.pending[0].arrival > step:
+                continue
+            r = st.pending[0]
+            p = int(np.asarray(r.prompt).shape[0])
+
+            def probe():
+                # The match is PURE (no LRU stamp), so probing before
+                # admission is decided cannot perturb the index.
+                if eng.prefix is None:
+                    return -1, 0, 0
+                entry, full = eng.prefix.match(r.prompt)
+                hit = min(full, p - 1)
+                return entry, full, hit if hit >= MIN_PREFIX_HIT else 0
+
+            entry, full, hit = probe()
+            if eng.paged:
+                while True:
+                    need = eng.pages_needed(p + r.max_new_tokens) \
+                        - hit // eng.page_size
+                    if eng.pages.available >= need:
                         break
-                    t0 = eligible_wall.get(r.id)
-                    if t0 is None:
-                        continue
-                    lims = [v for v in self._deadline_for(r) if v is not None]
-                    if lims and now - t0 > min(lims):
-                        expired.append(r)
-                for r in expired:
-                    expire_queued(r, "deadline_exceeded")
-                for s in range(S):
-                    r = occupant[s]
-                    if r is None:
-                        continue
-                    ttft, total = self._deadline_for(r)
-                    # Pre-first-token both deadlines bound the wait;
-                    # once decoding, only the total deadline applies.
-                    lims = [v for v in ((ttft, total) if not active[s]
-                                        else (total,)) if v is not None]
-                    if lims and now - eligible_wall[r.id] > min(lims):
-                        finish(s, status="deadline_exceeded")
-            # Admit: claim every free slot whose turn has come. With the
-            # prefix cache, admission itself is only the (optional) row
-            # copy (contiguous) or table mapping (paged) — prompt
-            # compute happens in the prefill phase below. On the paged
-            # pool, admission FIRST checks "enough free pages" for the
-            # request's worst case (prompt + max_new, minus the full
-            # pages a prefix hit shares) and RESERVES them — capacity
-            # pools across slots instead of a per-slot worst-case ring.
-            # The queue stays strictly FIFO: when the head cannot fit,
-            # nothing behind it admits either (deterministic, and no
-            # small-request starvation of the long head).
-            for s in range(S):
-                if occupant[s] is not None or not pending \
-                        or pending[0].arrival > step:
-                    continue
-                r = pending[0]
-                p = int(np.asarray(r.prompt).shape[0])
-
-                def probe():
-                    # The match is PURE (no LRU stamp), so probing before
-                    # admission is decided cannot perturb the index.
-                    if eng.prefix is None:
-                        return -1, 0, 0
-                    entry, full = eng.prefix.match(r.prompt)
-                    hit = min(full, p - 1)
-                    return entry, full, hit if hit >= MIN_PREFIX_HIT else 0
-
-                entry, full, hit = probe()
-                if eng.paged:
-                    while True:
-                        need = eng.pages_needed(p + r.max_new_tokens) \
-                            - hit // eng.page_size
-                        if eng.pages.available >= need:
-                            break
-                        if not eng.reclaim_pages(need):
-                            need = -1
-                            break
-                        # Reclaim may have evicted the matched entry
-                        # itself (it was zero-ref) — re-probe so the
-                        # fetch below can never reference a ghost and
-                        # the reservation covers the (possibly shrunk)
-                        # hit. Entries strictly decrease per round, so
-                        # this terminates.
-                        entry, full, hit = probe()
-                    if need < 0:
-                        break  # head waits for pages; FIFO holds
-                    eng.reserve_pages(s, need)
-                pending.popleft()
-                occupant[s] = r
-                generated[s] = []
-                admitted_at[s] = step
-                base = 0
-                store_after[s] = False
-                if tr:
-                    tr.event("admit", req=int(r.id), slot=s, step=step)
-                if eng.prefix is not None:
-                    lookups += 1
-                    if hit >= MIN_PREFIX_HIT:
-                        t0 = time.perf_counter() if tr else 0.0
-                        copied = eng.prefix_fetch(entry, hit, s)
-                        if tr:
-                            # Contiguous: a pool->slot row gather of all
-                            # `hit` rows. Paged: zero-copy page mapping;
-                            # copied_rows is the CoW partial tail page
-                            # only (< page_size — the zero-copy pin
-                            # asserts on exactly this attribute).
-                            tr.complete(
-                                "prefix_map" if eng.paged
-                                else "prefix_copy",
-                                t0, time.perf_counter(),
-                                req=int(r.id), slot=s, rows=hit,
-                                copied_rows=int(copied),
-                            )
-                        held_entry[s] = entry
-                        base = hit
-                        hits += 1
-                        saved += hit
-                    # Register once the whole prompt is resident IF the
-                    # cache covers less than half of it: a true miss, or
-                    # a prompt extending its prefix meaningfully (the
-                    # multi-turn case — context + a long continuation).
-                    # Re-registering every hitting prompt would thrash
-                    # the pool instead: each unique-tail registration
-                    # evicts another family's live prefix, and the hit
-                    # rate collapses (measured in serve_bench's
-                    # prefix_compare before this policy existed).
-                    store_after[s] = full < max(p // 2, MIN_PREFIX_HIT)
-                prefilled[s] = base
-                # While this slot is mid-prefill, decode ticks still
-                # compute it (fixed shapes) and write one PAD_POS row at
-                # `lengths[s]` — keep that pointed at the NEXT chunk's
-                # first row (overwritten by the chunk anyway), never at
-                # a stale value that could stomp rows already resident.
-                lengths[s] = base
-            # Prefill: advance every occupied-but-not-active slot, whole
-            # prompt at once when chunking is off, else chunk-at-a-time
-            # under the shared per-tick token budget.
-            budget = budget0
-            prefilled_any = False
-            for s in range(S):
-                r = occupant[s]
-                if r is None or active[s]:
-                    continue
-                if inj is not None and inj.stalls(r.id):
-                    # Injected stall (resilience.faults): the prefill
-                    # never advances — the hung-upstream failure mode a
-                    # deadline must evict (validated at submit: a
-                    # stalled request always has one).
-                    continue
-                prompt = np.asarray(r.prompt, np.int32)
-                p = int(prompt.shape[0])
-                while prefilled[s] < p:
-                    todo = p - int(prefilled[s])
-                    n = todo if not chunk else min(chunk, todo)
-                    if budget0 and budget < n:
-                        break  # out of tick budget; resume next tick
-                    base = int(prefilled[s])
+                    if not eng.reclaim_pages(need):
+                        need = -1
+                        break
+                    # Reclaim may have evicted the matched entry
+                    # itself (it was zero-ref) — re-probe so the
+                    # fetch below can never reference a ghost and
+                    # the reservation covers the (possibly shrunk)
+                    # hit. Entries strictly decrease per round, so
+                    # this terminates.
+                    entry, full, hit = probe()
+                if need < 0:
+                    break  # head waits for pages; FIFO holds
+                eng.reserve_pages(s, need)
+            st.pending.popleft()
+            st.occupant[s] = r
+            st.generated[s] = []
+            st.admitted_at[s] = step
+            base = 0
+            st.store_after[s] = False
+            if tr:
+                tr.event("admit", req=int(r.id), slot=s, step=step)
+            if eng.prefix is not None:
+                st.lookups += 1
+                if hit >= MIN_PREFIX_HIT:
                     t0 = time.perf_counter() if tr else 0.0
-                    with prefill_timer.step(images=n):
-                        tok, _ = eng.prefill(
-                            prompt[base:base + n], slot=s,
-                            request_id=r.id, base=base,
-                        )
+                    copied = eng.prefix_fetch(entry, hit, s)
                     if tr:
-                        tr.complete("prefill_chunk", t0,
-                                    time.perf_counter(),
-                                    req=int(r.id), slot=s, base=base, n=n)
-                    if reg is not None:
-                        reg.counter("serve_prefill_tokens_total").inc(n)
-                        # The SAME bracket value the StepTimer recorded,
-                        # so the two latency surfaces cannot disagree.
-                        reg.histogram("serve_prefill_seconds").observe(
-                            prefill_timer._times[-1]
+                        # Contiguous: a pool->slot row gather of all
+                        # `hit` rows. Paged: zero-copy page mapping;
+                        # copied_rows is the CoW partial tail page
+                        # only (< page_size — the zero-copy pin
+                        # asserts on exactly this attribute).
+                        tr.complete(
+                            "prefix_map" if eng.paged
+                            else "prefix_copy",
+                            t0, time.perf_counter(),
+                            req=int(r.id), slot=s, rows=hit,
+                            copied_rows=int(copied),
                         )
-                    prefilled[s] += n
-                    prefilled_any = True
-                    lengths[s] = prefilled[s]  # see admission comment
-                    if budget0:
-                        budget -= n
-                    if base + n == p:  # prompt complete: first token
-                        if eng.prefix is not None and store_after[s]:
-                            stored = eng.prefix_store(prompt, s)
-                            if tr and stored:
-                                tr.event("prefix_store", req=int(r.id),
-                                         slot=s, rows=p)
-                        active[s] = True
-                        lengths[s] = p
-                        last_tokens[s] = tok
-                        req_ids[s] = r.id
-                        generated[s] = [tok]
-                        t_first = time.perf_counter()
-                        ttfts.append(t_first - eligible_wall[r.id])
-                        if tr:
-                            # Same `t_first` as the TTFT sample above —
-                            # derive_request_slo recovers it exactly.
-                            tr.event("first_token", t=t_first,
-                                     req=int(r.id), slot=s, step=step)
-                        if reg is not None:
-                            reg.histogram("serve_ttft_seconds").observe(
-                                ttfts[-1]
-                            )
-                        if finished(s, tok):
-                            finish(s)
-                        break
-            if active.any():
-                n_active = int(active.sum())
+                    st.held_entry[s] = entry
+                    base = hit
+                    st.hits += 1
+                    st.saved += hit
+                # Register once the whole prompt is resident IF the
+                # cache covers less than half of it: a true miss, or
+                # a prompt extending its prefix meaningfully (the
+                # multi-turn case — context + a long continuation).
+                # Re-registering every hitting prompt would thrash
+                # the pool instead: each unique-tail registration
+                # evicts another family's live prefix, and the hit
+                # rate collapses (measured in serve_bench's
+                # prefix_compare before this policy existed).
+                st.store_after[s] = full < max(p // 2, MIN_PREFIX_HIT)
+            st.prefilled[s] = base
+            # While this slot is mid-prefill, decode ticks still
+            # compute it (fixed shapes) and write one PAD_POS row at
+            # `lengths[s]` — keep that pointed at the NEXT chunk's
+            # first row (overwritten by the chunk anyway), never at
+            # a stale value that could stomp rows already resident.
+            st.lengths[s] = base
+        # Prefill: advance every occupied-but-not-active slot, whole
+        # prompt at once when chunking is off, else chunk-at-a-time
+        # under the shared per-tick token budget.
+        budget = budget0
+        prefilled_any = False
+        for s in range(S):
+            r = st.occupant[s]
+            if r is None or st.active[s]:
+                continue
+            if inj is not None and inj.stalls(r.id):
+                # Injected stall (resilience.faults): the prefill
+                # never advances — the hung-upstream failure mode a
+                # deadline must evict (validated at submit: a
+                # stalled request always has one).
+                continue
+            prompt = np.asarray(r.prompt, np.int32)
+            p = int(prompt.shape[0])
+            while st.prefilled[s] < p:
+                todo = p - int(st.prefilled[s])
+                n = todo if not chunk else min(chunk, todo)
+                if budget0 and budget < n:
+                    break  # out of tick budget; resume next tick
+                base = int(st.prefilled[s])
                 t0 = time.perf_counter() if tr else 0.0
-                with decode_timer.step(images=n_active):
-                    nxt, _ = eng.decode(last_tokens, lengths, req_ids, active)
-                now = time.perf_counter()
-                chained = last_decode_done is not None
-                if chained:
-                    # The gap since the previous decode completion —
-                    # prefill work interleaved between ticks included.
-                    itls.append(now - last_decode_done)
-                last_decode_done = now
+                with st.prefill_timer.step(images=n):
+                    tok, _ = eng.prefill(
+                        prompt[base:base + n], slot=s,
+                        request_id=r.id, base=base,
+                    )
                 if tr:
-                    # End timestamp == the ITL clock's `now`; `chained`
-                    # records whether the gap-to-previous counted, so
-                    # derive_request_slo replays the ITL stream exactly.
-                    tr.complete("decode_tick", t0, now, step=step,
-                                n_active=n_active, chained=chained)
+                    tr.complete("prefill_chunk", t0,
+                                time.perf_counter(),
+                                req=int(r.id), slot=s, base=base, n=n)
                 if reg is not None:
-                    reg.counter("serve_decode_tokens_total").inc(n_active)
-                    reg.histogram("serve_decode_step_seconds").observe(
-                        decode_timer._times[-1]
+                    reg.counter("serve_prefill_tokens_total").inc(n)
+                    # The SAME bracket value the StepTimer recorded,
+                    # so the two latency surfaces cannot disagree.
+                    reg.histogram("serve_prefill_seconds").observe(
+                        st.prefill_timer._times[-1]
                     )
-                    if chained:
-                        reg.histogram("serve_itl_seconds").observe(itls[-1])
-                for s in range(S):
-                    if not active[s]:
-                        continue
-                    lengths[s] += 1  # last_tokens[s] entered the cache
-                    tok = int(nxt[s])
-                    generated[s].append(tok)
-                    last_tokens[s] = tok
-                    if finished(s, tok):
-                        finish(s)
-            else:
-                # No decoder advanced this tick: the next decode's gap
-                # is idle/prefill lead-in, not an inter-token stall.
-                last_decode_done = None
-                if deadlines_on and not prefilled_any \
-                        and any(o is not None for o in occupant):
-                    # Only stalled/expiring work remains — yield the
-                    # host briefly instead of spinning the tick loop
-                    # flat-out until a wall-clock deadline passes.
-                    time.sleep(0.0005)
+                st.prefilled[s] += n
+                prefilled_any = True
+                st.lengths[s] = st.prefilled[s]  # see admission comment
+                if budget0:
+                    budget -= n
+                if base + n == p:  # prompt complete: first token
+                    if eng.prefix is not None and st.store_after[s]:
+                        stored = eng.prefix_store(prompt, s)
+                        if tr and stored:
+                            tr.event("prefix_store", req=int(r.id),
+                                     slot=s, rows=p)
+                    st.active[s] = True
+                    st.lengths[s] = p
+                    st.last_tokens[s] = tok
+                    st.req_ids[s] = r.id
+                    st.generated[s] = [tok]
+                    t_first = time.perf_counter()
+                    st.ttfts.append(t_first - st.eligible_wall[r.id])
+                    if tr:
+                        # Same `t_first` as the TTFT sample above —
+                        # derive_request_slo recovers it exactly.
+                        tr.event("first_token", t=t_first,
+                                 req=int(r.id), slot=s, step=step)
+                    if reg is not None:
+                        reg.histogram("serve_ttft_seconds").observe(
+                            st.ttfts[-1]
+                        )
+                    if self._finished(st, s, tok):
+                        self._finish(st, s)
+                    break
+        if st.active.any():
+            n_active = int(st.active.sum())
+            t0 = time.perf_counter() if tr else 0.0
+            with st.decode_timer.step(images=n_active):
+                nxt, _ = eng.decode(st.last_tokens, st.lengths,
+                                    st.req_ids, st.active)
+            now = time.perf_counter()
+            chained = st.last_decode_done is not None
+            if chained:
+                # The gap since the previous decode completion —
+                # prefill work interleaved between ticks included.
+                st.itls.append(now - st.last_decode_done)
+            st.last_decode_done = now
+            if tr:
+                # End timestamp == the ITL clock's `now`; `chained`
+                # records whether the gap-to-previous counted, so
+                # derive_request_slo replays the ITL stream exactly.
+                # `reqs` lists the slots' request ids that decoded this
+                # tick — the per-request/per-class ITL derivation's
+                # input (ISSUE 8: derive_request_slo group_by).
+                tr.complete("decode_tick", t0, now, step=step,
+                            n_active=n_active, chained=chained,
+                            reqs=[int(st.req_ids[i]) for i in range(S)
+                                  if st.active[i]])
             if reg is not None:
-                # Per-tick utilization gauges (sampled, last-write-wins
-                # in the registry; history lands in the JSONL snapshots).
-                depth = 0
-                for q in pending:  # (arrival, id)-sorted: early break
-                    if q.arrival > step:
-                        break
-                    depth += 1
-                reg.gauge("serve_queue_depth").set(depth)
-                reg.gauge("serve_active_slots").set(int(active.sum()))
-                reg.gauge("serve_occupied_slots").set(
-                    sum(o is not None for o in occupant)
+                reg.counter("serve_decode_tokens_total").inc(n_active)
+                reg.histogram("serve_decode_step_seconds").observe(
+                    st.decode_timer._times[-1]
                 )
-                if eng.prefix is not None:
-                    reg.gauge("serve_prefix_pool_entries").set(
-                        len(eng.prefix)
-                    )
-                if eng.paged:
-                    # Pool utilization (ISSUE 7 satellite): free pages
-                    # are the admission headroom, shared pages (ref >=
-                    # 2) the zero-copy prefix win made visible.
-                    reg.gauge("serve_kv_pages_free").set(eng.pages.free)
-                    reg.gauge("serve_kv_pages_shared").set(
-                        eng.pages.shared
-                    )
-                if self.metrics_writer is not None:
-                    # Rate-limited internally (interval_s): the per-tick
-                    # gauge HISTORY lands in the JSONL as a time series,
-                    # not just the final tick's values.
-                    self.metrics_writer.maybe_flush()
-            step += 1
-            if all(o is None for o in occupant) and pending:
-                # Idle gap before the next arrival: every intervening
-                # step would admit and decode nothing, so jump straight
-                # to it instead of spinning one Python iteration per
-                # empty step (pending is (arrival, id)-sorted).
-                step = max(step, pending[0].arrival)
-
-        latency = decode_timer.stats()
+                if chained:
+                    reg.histogram("serve_itl_seconds").observe(st.itls[-1])
+            for s in range(S):
+                if not st.active[s]:
+                    continue
+                st.lengths[s] += 1  # last_tokens[s] entered the cache
+                tok = int(nxt[s])
+                st.generated[s].append(tok)
+                st.last_tokens[s] = tok
+                if self._finished(st, s, tok):
+                    self._finish(st, s)
+        else:
+            # No decoder advanced this tick: the next decode's gap
+            # is idle/prefill lead-in, not an inter-token stall.
+            st.last_decode_done = None
+            if st.deadlines_on and not prefilled_any \
+                    and any(o is not None for o in st.occupant):
+                # Only stalled/expiring work remains — yield the
+                # host briefly instead of spinning the tick loop
+                # flat-out until a wall-clock deadline passes.
+                time.sleep(0.0005)
         if reg is not None:
-            reg.counter("serve_prefix_lookups_total").inc(lookups)
-            reg.counter("serve_prefix_hits_total").inc(hits)
-            reg.counter("serve_prefill_tokens_saved_total").inc(saved)
-        stats = ServeStats(
-            prefill_tokens=prefill_timer.total_images,
-            prefill_s=prefill_timer.total_s,
-            decode_tokens=decode_timer.total_images,
-            decode_steps=latency.steps,
-            decode_s=decode_timer.total_s,
-            slots=S,
-            latency=latency,
-            ttft=StepStats.from_times(ttfts),
-            itl=StepStats.from_times(itls),
-            prefix_lookups=lookups,
-            prefix_hits=hits,
-            prefill_tokens_saved=saved,
-        )
-        return done, stats
+            # Per-tick utilization gauges (sampled, last-write-wins
+            # in the registry; history lands in the JSONL snapshots).
+            depth = 0
+            for q in st.pending:  # (arrival, id)-sorted: early break
+                if q.arrival > step:
+                    break
+                depth += 1
+            reg.gauge("serve_queue_depth").set(depth)
+            reg.gauge("serve_active_slots").set(int(st.active.sum()))
+            reg.gauge("serve_occupied_slots").set(
+                sum(o is not None for o in st.occupant)
+            )
+            if eng.prefix is not None:
+                reg.gauge("serve_prefix_pool_entries").set(
+                    len(eng.prefix)
+                )
+            if eng.paged:
+                # Pool utilization (ISSUE 7 satellite): free pages
+                # are the admission headroom, shared pages (ref >=
+                # 2) the zero-copy prefix win made visible.
+                reg.gauge("serve_kv_pages_free").set(eng.pages.free)
+                reg.gauge("serve_kv_pages_shared").set(
+                    eng.pages.shared
+                )
+            if self.metrics_writer is not None:
+                # Rate-limited internally (interval_s): the per-tick
+                # gauge HISTORY lands in the JSONL as a time series,
+                # not just the final tick's values.
+                self.metrics_writer.maybe_flush()
+        st.step = step + 1
+        if all(o is None for o in st.occupant) and st.pending:
+            # Idle gap before the next arrival: every intervening
+            # step would admit and decode nothing, so jump straight
+            # to it instead of spinning one Python iteration per
+            # empty step (pending is (arrival, id)-sorted).
+            st.step = max(st.step, st.pending[0].arrival)
 
 
-def derive_request_slo(records) -> tuple[StepStats, StepStats]:
-    """``(ttft, itl)`` ``StepStats`` derived PURELY from a run's tracer
-    records (``Tracer.records`` or a read-back JSONL file).
+def request_slo_samples(records) -> dict[int, tuple[float, list[float]]]:
+    """Per-REQUEST SLO raw samples from a run's tracer records:
+    ``{request_id: (ttft_seconds, [itl_seconds, ...])}``.
 
-    Works because the scheduler stamps the lifecycle events with the
-    SAME ``perf_counter`` values its own SLO math uses: TTFT is
-    ``first_token.t - eligible.t`` per request, ITL the gap between
-    consecutive ``decode_tick`` end timestamps whose later tick is
-    ``chained`` (an idle/prefill-lead-in tick breaks the chain exactly
-    as the live computation's reset does). The result is EXACTLY equal
-    — same floats, not approximately — to ``ServeStats.ttft``/``.itl``
-    of the run that produced the records (pinned at tp=1 and tp=2 in
-    tests/test_obs.py), which is what makes the trace a sufficient
-    record of a run's SLO story."""
+    TTFT is ``first_token.t - eligible.t``. The per-request ITL stream
+    is the gaps between that request's consecutive TOKEN emission
+    times — its ``first_token`` stamp followed by the end timestamp of
+    every ``decode_tick`` whose ``reqs`` attribute lists it (the
+    scheduler records exactly the slots that decoded each tick, so a
+    request's token times are recoverable without knowing slot
+    assignments). Requests that never reached a first token (shed,
+    expired in queue) are absent. This is the shared substrate of the
+    grouped :func:`derive_request_slo` AND the router's per-class SLO
+    attainment — one definition, two consumers (ISSUE 8)."""
     eligible: dict[int, float] = {}
-    ttfts: list[float] = []
-    itls: list[float] = []
-    prev: float | None = None
+    first: dict[int, float] = {}
+    token_times: dict[int, list[float]] = {}
     for rec in records:
         name = rec.get("name")
         attrs = rec.get("attrs", {})
         if name == "eligible":
             eligible.setdefault(attrs["req"], rec["t"])
         elif name == "first_token":
-            ttfts.append(rec["t"] - eligible[attrs["req"]])
+            rid = attrs["req"]
+            first[rid] = rec["t"]
+            token_times.setdefault(rid, []).append(rec["t"])
         elif name == "decode_tick":
-            if attrs.get("chained") and prev is not None:
-                itls.append(rec["t"] - prev)
-            prev = rec["t"]
-    return StepStats.from_times(ttfts), StepStats.from_times(itls)
+            for rid in attrs.get("reqs", ()):
+                token_times.setdefault(rid, []).append(rec["t"])
+    out: dict[int, tuple[float, list[float]]] = {}
+    for rid, t1 in first.items():
+        ts = token_times[rid]
+        out[rid] = (t1 - eligible[rid],
+                    [b - a for a, b in zip(ts, ts[1:])])
+    return out
+
+
+def derive_request_slo(records, group_by=None):
+    """SLO stats derived PURELY from a run's tracer records
+    (``Tracer.records`` or a read-back JSONL file).
+
+    ``group_by=None`` (default): returns the run-global ``(ttft, itl)``
+    ``StepStats`` pair. Works because the scheduler stamps the
+    lifecycle events with the SAME ``perf_counter`` values its own SLO
+    math uses: TTFT is ``first_token.t - eligible.t`` per request, ITL
+    the gap between consecutive ``decode_tick`` end timestamps whose
+    later tick is ``chained`` (an idle/prefill-lead-in tick breaks the
+    chain exactly as the live computation's reset does). The result is
+    EXACTLY equal — same floats, not approximately — to
+    ``ServeStats.ttft``/``.itl`` of the run that produced the records
+    (pinned at tp=1 and tp=2 in tests/test_obs.py), which is what makes
+    the trace a sufficient record of a run's SLO story.
+
+    ``group_by`` (ISSUE 8 satellite): a dict or callable mapping
+    request id -> group label (``None`` drops the request). Returns
+    ``{label: (ttft, itl)}`` where both stats pool PER-REQUEST samples
+    (:func:`request_slo_samples`) over the group's members and delegate
+    to ``StepStats.from_times`` — the single percentile definition the
+    whole repo uses. Because the grouped path touches only its own
+    members' per-request streams, the result for a group is IDENTICAL
+    to filtering the records to that group first and deriving then
+    (pinned in tests/test_obs.py): per-class and per-replica breakdowns
+    are the same computation, just keyed differently. Per-request ITL
+    needs the ``decode_tick`` ``reqs`` attribute (present from ISSUE 8
+    on); older traces yield empty grouped ITL."""
+    if group_by is None:
+        eligible: dict[int, float] = {}
+        ttfts: list[float] = []
+        itls: list[float] = []
+        prev: float | None = None
+        for rec in records:
+            name = rec.get("name")
+            attrs = rec.get("attrs", {})
+            if name == "eligible":
+                eligible.setdefault(attrs["req"], rec["t"])
+            elif name == "first_token":
+                ttfts.append(rec["t"] - eligible[attrs["req"]])
+            elif name == "decode_tick":
+                if attrs.get("chained") and prev is not None:
+                    itls.append(rec["t"] - prev)
+                prev = rec["t"]
+        return StepStats.from_times(ttfts), StepStats.from_times(itls)
+    key_of = group_by if callable(group_by) else group_by.get
+    grouped: dict[object, tuple[list[float], list[float]]] = {}
+    for rid, (ttft, itls_r) in request_slo_samples(records).items():
+        key = key_of(rid)
+        if key is None:
+            continue
+        g = grouped.setdefault(key, ([], []))
+        g[0].append(ttft)
+        g[1].extend(itls_r)
+    return {
+        k: (StepStats.from_times(tt), StepStats.from_times(ii))
+        for k, (tt, ii) in grouped.items()
+    }

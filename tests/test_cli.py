@@ -248,6 +248,12 @@ def test_main_serve_prefix_cache_and_chunked_prefill(capsys):
     assert payload["prefix_lookups"] == 3
     assert payload["ttft_ms"]["p95"] > 0
     assert len(payload["completions"]) == 3
+    # ISSUE 8 satellite: the single-engine path tallies one "default"
+    # class — same JSON shape the router path fills with real classes.
+    assert payload["per_class"] == {
+        "default": {"total": 3, "ok": 3, "shed": 0,
+                    "deadline_exceeded": 0}
+    }
     assert all(len(c["tokens"]) == 4
                for c in payload["completions"].values())
 
@@ -275,6 +281,75 @@ def test_main_serve_paged_pool_end_to_end(capsys):
     assert len(payload["completions"]) == 3
     assert all(c["status"] == "ok" and len(c["tokens"]) == 4
                for c in payload["completions"].values())
+
+
+def test_main_serve_router_end_to_end_from_checkpoint(tmp_path, capsys):
+    """ISSUE 8 CLI surface: a tiny lm training run leaves a checkpoint;
+    ``serve --replicas 2 --traffic ... --slo ...`` serves a mixed
+    two-class stream from it through the router — the JSON contract
+    carries per-class completion/status tallies (the chaos-chain
+    assertion surface), the router summary with per-replica placements,
+    and per-completion traffic classes."""
+    d = str(tmp_path / "ck")
+    model = ["--vocab", "16", "--d-model", "32", "--heads", "2",
+             "--layers", "2", "--d-ff", "64"]
+    assert main(["lm", "--num-workers", "1", "--seq-scheme", "full",
+                 "--seq-len", "16", "--train-seqs", "32", "--test-seqs",
+                 "8", "--batch-size", "16", "--eval-every", "2",
+                 "--checkpoint-dir", d] + model) == 0
+    capsys.readouterr()
+    assert main([
+        "serve", "--replicas", "2", "--checkpoint-dir", d, "--slots", "2",
+        "--capacity", "64", "--prefix-cache", "2", "--shed-threshold", "4",
+        "--traffic",
+        "horizon=8;max_requests=8;seed=5;"
+        "chat:rate=0.9,pmin=6,pmax=10,new=2,families=2,fprefix=4;"
+        "bulk:rate=0.5,pmin=6,pmax=10,new=2",
+        "--slo", "chat:ttft=30,priority=0;bulk:ttft=60,priority=2",
+        "--json"] + model) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["variant"] == "serve" and payload["replicas"] == 2
+    assert len(payload["completions"]) == 8
+    classes = {c["traffic_class"] for c in payload["completions"].values()}
+    assert classes <= {"chat", "bulk"} and len(classes) == 2
+    tallies = payload["per_class"]
+    assert sum(row["total"] for row in tallies.values()) == 8
+    for row in tallies.values():
+        assert row["total"] == row["ok"] + row["shed"] \
+            + row["deadline_exceeded"]
+    router = payload["router"]
+    assert len(router["per_replica_requests"]) == 2
+    assert sum(router["per_replica_requests"]) + router["router_sheds"] == 8
+    assert set(router["per_class"]) == classes
+    for row in router["per_class"].values():
+        assert 0.0 <= row["ttft_slo_attained"] <= 1.0
+
+
+def test_main_serve_router_flag_hygiene():
+    """Router flag hygiene both directions: --traffic/--slo without
+    --replicas fail loudly, router flags fail on training variants,
+    bare-prompt-set flags fail under --replicas, and malformed specs
+    are config errors."""
+    with pytest.raises(SystemExit, match="--traffic requires --replicas"):
+        main(["serve", "--platform", "cpu", "--traffic", "chat:rate=1"])
+    with pytest.raises(SystemExit, match="--slo requires --replicas"):
+        main(["serve", "--platform", "cpu", "--slo", "chat:ttft=1"])
+    with pytest.raises(SystemExit, match="--replicas"):
+        main(["lm", "--replicas", "2"])
+    with pytest.raises(SystemExit, match="--num-prompts does not apply"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--num-prompts", "5"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--traffic", "chat:rate=1,nope=3"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--traffic", "chat:rate=1,pmin=8,pmax=300,new=8"])
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--slo", "nope:ttft=1"])
+    with pytest.raises(SystemExit, match="--replicas must be >= 1"):
+        main(["serve", "--platform", "cpu", "--replicas", "0"])
 
 
 def test_main_serve_rejects_bad_prefix_chunk_flags():

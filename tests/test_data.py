@@ -168,6 +168,138 @@ def test_longtail_prompts_structure_and_validation():
         synthesize_longtail_prompts(vocab=1)
 
 
+def test_mixed_traffic_determinism_and_structure():
+    """ISSUE 8 satellite: the mixed-traffic generator is
+    seed-deterministic (ids, arrivals, classes, families, prompts),
+    arrivals are sorted with sequential ids, family classes share their
+    exact prefix, and max_requests truncates the stream in (arrival,
+    id) order."""
+    from ddl_tpu.data.lm import synthesize_mixed_traffic
+
+    classes = {"chat": dict(rate=0.8, prompt_min=6, prompt_max=10,
+                            max_new_tokens=2, families=2,
+                            family_prefix_len=4),
+               "bulk": dict(rate=0.4, prompt_min=6, prompt_max=12,
+                            max_new_tokens=2)}
+    a = synthesize_mixed_traffic(classes=classes, horizon=16, vocab=32,
+                                 seed=7)
+    b = synthesize_mixed_traffic(classes=classes, horizon=16, vocab=32,
+                                 seed=7)
+    c = synthesize_mixed_traffic(classes=classes, horizon=16, vocab=32,
+                                 seed=8)
+    assert len(a) == len(b) > 0
+    assert all(
+        x.id == y.id and x.arrival == y.arrival
+        and x.traffic_class == y.traffic_class and x.family == y.family
+        and np.array_equal(x.prompt, y.prompt)
+        for x, y in zip(a, b)
+    )
+    assert len(a) != len(c) or any(
+        not np.array_equal(x.prompt, y.prompt) for x, y in zip(a, c)
+    )
+    assert [m.id for m in a] == list(range(len(a)))
+    arrivals = [m.arrival for m in a]
+    assert arrivals == sorted(arrivals)
+    assert all(0 <= t < 16 for t in arrivals)
+    for m in a:
+        assert m.prompt.dtype == np.int32 and m.prompt[0] == 0
+        assert (m.prompt[1:] >= 1).all() and (m.prompt[1:] < 32).all()
+        lo, hi = (6, 10) if m.traffic_class == "chat" else (6, 12)
+        assert lo <= len(m.prompt) <= hi
+        assert (m.family >= 0) == (m.traffic_class == "chat")
+    # Family members open with the SAME 4-token prefix; distinct
+    # families differ (astronomically likely at this vocab).
+    chat = [m for m in a if m.traffic_class == "chat"]
+    by_fam = {}
+    for m in chat:
+        by_fam.setdefault(m.family, []).append(m)
+    for fam, members in by_fam.items():
+        for m in members:
+            np.testing.assert_array_equal(m.prompt[:4],
+                                          members[0].prompt[:4])
+    if len(by_fam) == 2:
+        f0, f1 = (ms[0] for ms in by_fam.values())
+        assert not np.array_equal(f0.prompt[:4], f1.prompt[:4])
+    capped = synthesize_mixed_traffic(classes=classes, horizon=16,
+                                      vocab=32, seed=7, max_requests=5)
+    assert len(capped) == 5
+    assert all(np.array_equal(x.prompt, y.prompt)
+               for x, y in zip(capped, a[:5]))
+
+
+def test_mixed_traffic_poisson_burst_and_diurnal():
+    """Arrival statistics: the empirical per-tick rate tracks the
+    Poisson mean over a long horizon; a burst window's rate is
+    multiplied; a diurnal ramp moves arrivals from trough to peak."""
+    from ddl_tpu.data.lm import synthesize_mixed_traffic
+
+    one = {"c": dict(rate=0.4, prompt_min=4, prompt_max=6,
+                     max_new_tokens=1)}
+    long_run = synthesize_mixed_traffic(classes=one, horizon=1500,
+                                        vocab=16, seed=1)
+    mean = len(long_run) / 1500
+    # 1500 ticks at lam=0.4: sd of the mean ~ 0.016 — +-0.08 is 5 sigma.
+    assert abs(mean - 0.4) < 0.08, mean
+
+    bursty = synthesize_mixed_traffic(classes=one, horizon=60, vocab=16,
+                                      seed=2, burst=(20, 10, 8.0, "c"))
+    inside = sum(1 for m in bursty if 20 <= m.arrival < 30)
+    outside = len(bursty) - inside
+    # Window rate ~3.2/tick vs 0.4/tick outside: the window dominates.
+    assert inside / 10 > 3 * max(outside, 1) / 50, (inside, outside)
+
+    wave = synthesize_mixed_traffic(classes=one, horizon=64, vocab=16,
+                                    seed=3, diurnal_amplitude=0.9,
+                                    diurnal_period=64)
+    peak = sum(1 for m in wave if m.arrival < 32)  # sin >= 0 half
+    trough = len(wave) - peak
+    assert peak > trough, (peak, trough)
+
+
+def test_mixed_traffic_validation():
+    """Malformed scenario specs fail fast naming the offender."""
+    from ddl_tpu.data.lm import synthesize_mixed_traffic
+
+    ok = {"c": dict(rate=0.5, prompt_min=4, prompt_max=8,
+                    max_new_tokens=2)}
+    with pytest.raises(ValueError, match="at least one traffic class"):
+        synthesize_mixed_traffic(classes={})
+    with pytest.raises(ValueError, match="horizon"):
+        synthesize_mixed_traffic(classes=ok, horizon=0)
+    with pytest.raises(ValueError, match="vocab"):
+        synthesize_mixed_traffic(classes=ok, vocab=1)
+    with pytest.raises(ValueError, match="max_requests"):
+        synthesize_mixed_traffic(classes=ok, max_requests=-1)
+    with pytest.raises(ValueError, match="unknown spec keys"):
+        synthesize_mixed_traffic(classes={"c": dict(rate=1, nope=2)})
+    with pytest.raises(ValueError, match="rate"):
+        synthesize_mixed_traffic(classes={"c": dict(rate=-1)})
+    with pytest.raises(ValueError, match="prompt_min"):
+        synthesize_mixed_traffic(
+            classes={"c": dict(rate=1, prompt_min=9, prompt_max=4)}
+        )
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        synthesize_mixed_traffic(
+            classes={"c": dict(rate=1, max_new_tokens=0)}
+        )
+    with pytest.raises(ValueError, match="family_prefix_len"):
+        synthesize_mixed_traffic(classes={
+            "c": dict(rate=1, prompt_min=4, prompt_max=8, families=2,
+                      family_prefix_len=4)
+        })
+    with pytest.raises(ValueError, match="burst"):
+        synthesize_mixed_traffic(classes=ok, burst=(1, 2))
+    with pytest.raises(ValueError, match="burst"):
+        synthesize_mixed_traffic(classes=ok, burst=(0, 0, 2.0))
+    with pytest.raises(ValueError, match="not a traffic class"):
+        synthesize_mixed_traffic(classes=ok, burst=(0, 2, 2.0, "nope"))
+    with pytest.raises(ValueError, match="diurnal_amplitude"):
+        synthesize_mixed_traffic(classes=ok, diurnal_amplitude=1.5,
+                                 diurnal_period=8)
+    with pytest.raises(ValueError, match="diurnal_period"):
+        synthesize_mixed_traffic(classes=ok, diurnal_amplitude=0.5)
+
+
 def test_one_hot_matches_get_dummies_semantics():
     y = np.array([3, 0, 9, 3])
     oh = one_hot(y)

@@ -17,16 +17,21 @@ Bounds (per test function, per run):
   the larger of the prompt-set size (literal ``num=`` /
   ``n_families * per_family`` / ``num_short + num_long`` of a
   ``synthesize_*prompts`` call — the long-tail generator of the paged
-  serve tests included) and
-  the count of ``Request(...)`` constructor sites, and
+  serve tests included — or ``max_requests=`` of a
+  ``synthesize_mixed_traffic`` call, the ISSUE 8 router-stream bound)
+  and the count of ``Request(...)`` constructor sites, and
   ``max_new_tokens`` is the largest resolvable int literal passed under
-  that keyword. Code inside ``pytest.raises`` blocks is excluded (a
-  rejected request generates nothing).
+  that keyword to a ``Request(...)`` or a ``dict(...)`` (the mixed-
+  traffic class-spec shape). Code inside ``pytest.raises`` blocks is
+  excluded (a rejected request generates nothing).
 - **> 2 topologies** — the product of literal tuple/list lengths over
   ``for`` loops whose bodies construct ``ServeConfig`` /
-  ``InferenceEngine`` (each iteration compiles a fresh engine).
-  ``pytest.mark.parametrize`` cases are separate tier-1 tests and are
-  deliberately NOT multiplied in.
+  ``InferenceEngine`` (each iteration compiles a fresh engine), AND at
+  least the SUM of literal ``replicas=`` over ``Router`` /
+  ``RouterConfig`` constructor sites (ISSUE 8: every replica is its own
+  compiled engine, and a test building two N-replica routers pays 2N
+  compiles). ``pytest.mark.parametrize`` cases are separate tier-1
+  tests and are deliberately NOT multiplied in.
 
 The estimate is a documented LOWER bound: unresolvable (non-literal)
 values contribute nothing, so the audit can miss creative obfuscation
@@ -43,8 +48,9 @@ import textwrap
 MAX_FAST_TOKENS = 64
 MAX_FAST_TOPOLOGIES = 2
 _PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts",
-                   "synthesize_longtail_prompts")
+                   "synthesize_longtail_prompts", "synthesize_mixed_traffic")
 _ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
+_ROUTER_CTORS = ("Router", "RouterConfig")
 
 
 def _call_name(node: ast.Call) -> str | None:
@@ -105,10 +111,11 @@ def estimate(fn) -> tuple[bool, int, int]:
     request_sites = 0
     max_new = 0
     topologies = 1
+    router_replicas = 0
     for node in ast.walk(fn):
         if id(node) in skip:
             continue
-        if isinstance(node, ast.Name) and node.id == "Scheduler":
+        if isinstance(node, ast.Name) and node.id in ("Scheduler", "Router"):
             uses_scheduler = True
         if isinstance(node, ast.For) and isinstance(
             node.iter, (ast.Tuple, ast.List)
@@ -123,11 +130,18 @@ def estimate(fn) -> tuple[bool, int, int]:
         if not isinstance(node, ast.Call):
             continue
         name = _call_name(node)
-        if name == "Request":
-            request_sites += 1
+        if name in ("Request", "dict"):
+            # dict() covers the mixed-traffic class specs — their
+            # max_new_tokens bounds every generated request's budget.
+            if name == "Request":
+                request_sites += 1
             v = _kw_int(node, "max_new_tokens")
             if v is not None:
                 max_new = max(max_new, v)
+        elif name in _ROUTER_CTORS:
+            v = _kw_int(node, "replicas")
+            if v is not None:
+                router_replicas += v
         elif name == "synthesize_prompts":
             v = _kw_int(node, "num")
             if v is not None:
@@ -140,8 +154,12 @@ def estimate(fn) -> tuple[bool, int, int]:
             ns = _kw_int(node, "num_short") or 0
             nl = _kw_int(node, "num_long") or 0
             prompt_set = max(prompt_set, ns + nl)
+        elif name == "synthesize_mixed_traffic":
+            v = _kw_int(node, "max_requests")
+            if v is not None:
+                prompt_set = max(prompt_set, v)
     tokens = max(prompt_set, request_sites) * max_new
-    return uses_scheduler, tokens, topologies
+    return uses_scheduler, tokens, max(topologies, router_replicas)
 
 
 def _audit(tree) -> list[tuple[str, int, int]]:
@@ -175,6 +193,66 @@ def test_serve_scheduler_tests_carry_slow_marker():
         f"(<= {MAX_FAST_TOKENS} tokens, <= {MAX_FAST_TOPOLOGIES} "
         "topologies)"
     )
+
+
+def test_router_audit_estimator_flags_and_permits():
+    """ISSUE 8 self-pin: Router tests count into the audit — replicas
+    literals SUM into the topology bound (two 3-replica routers = six
+    engines), synthesize_mixed_traffic's max_requests is the request
+    bound, class-spec dict(max_new_tokens=...) literals bound the token
+    budget, and a Router name alone marks the test as
+    scheduler-driving."""
+    src = textwrap.dedent("""
+        import pytest
+
+        def test_replica_overrun():
+            cfg = RouterConfig(serve=ServeConfig(), replicas=3)
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=2)},
+                max_requests=4)
+            Router(cfg).run(t)
+
+        def test_two_router_sites_overrun():
+            a = Router(RouterConfig(serve=ServeConfig(), replicas=2))
+            b = Router(RouterConfig(serve=ServeConfig(), replicas=2))
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=1)},
+                max_requests=4)
+            a.run(t); b.run(t)
+
+        def test_mixed_token_overrun():
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=4)},
+                max_requests=40)
+            Router(RouterConfig(serve=ServeConfig(), replicas=2)).run(t)
+
+        def test_in_budget_router():
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=2)},
+                max_requests=10)
+            Router(RouterConfig(serve=ServeConfig(), replicas=2)).run(t)
+
+        def test_rejected_router_exempt():
+            with pytest.raises(ValueError):
+                Router(RouterConfig(serve=ServeConfig(), replicas=9))
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_replica_overrun", "test_two_router_sites_overrun",
+                     "test_mixed_token_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_replica_overrun"])
+    assert uses and tokens == 8 and topo == 3
+    uses, tokens, topo = estimate(fns["test_two_router_sites_overrun"])
+    assert uses and tokens == 4 and topo == 4  # replicas SUM across sites
+    uses, tokens, topo = estimate(fns["test_mixed_token_overrun"])
+    assert uses and tokens == 160 and topo == 2
+    uses, tokens, topo = estimate(fns["test_in_budget_router"])
+    assert uses and tokens == 20 and topo == 2
+    # A Router referenced ONLY inside pytest.raises never runs: the
+    # whole test is exempt, same as the Request/fault conventions.
+    uses, tokens, topo = estimate(fns["test_rejected_router_exempt"])
+    assert not uses and tokens == 0 and topo == 1
 
 
 # -- fault-injection trainer audit (ISSUE 6 satellite) ------------------------

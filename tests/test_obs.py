@@ -227,6 +227,75 @@ def test_derived_ttft_itl_equal_servestats_tp1_tp2():
                 <= ts["complete"]
 
 
+def test_derive_request_slo_group_by_grouped_equals_filtered():
+    """ISSUE 8 satellite: ``derive_request_slo(records, group_by=...)``
+    pools PER-REQUEST samples by group with the single
+    ``StepStats.from_times`` percentile definition, and a group's
+    result is IDENTICAL to filtering the records to that group first
+    and deriving then. The ungrouped path stays the exact-ServeStats
+    derivation (pinned above)."""
+    from ddl_tpu.serve import (
+        InferenceEngine,
+        Request,
+        Scheduler,
+        ServeConfig,
+        derive_request_slo,
+        request_slo_samples,
+    )
+
+    prompts = synthesize_prompts(num=6, min_len=4, max_len=8,
+                                 vocab=SPEC.vocab, seed=31)
+    reqs = [Request(id=i, prompt=p, max_new_tokens=4, arrival=i % 2)
+            for i, p in enumerate(prompts)]
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=32))
+    tracer = Tracer()
+    sched = Scheduler(eng, tracer=tracer)
+    done, stats = sched.run(reqs)
+    cls_of = {i: ("chat" if i % 2 == 0 else "bulk") for i in range(6)}
+    grouped = derive_request_slo(tracer.records, group_by=cls_of)
+    assert set(grouped) == {"chat", "bulk"}
+    # Every request contributes exactly one TTFT sample to its group.
+    assert grouped["chat"][0].steps == 3
+    assert grouped["bulk"][0].steps == 3
+    # Per-request ITL exists (multi-token requests decode repeatedly).
+    assert grouped["chat"][1].steps > 0
+
+    # THE pin: grouped ≡ filtered-then-derived. Filtering keeps the
+    # group's request-scoped events and intersects decode_tick `reqs`
+    # with the group — deriving the filtered stream under a constant
+    # group_by must reproduce the grouped entry field for field.
+    for cls in ("chat", "bulk"):
+        members = {i for i, c in cls_of.items() if c == cls}
+        filtered = []
+        for rec in tracer.records:
+            attrs = rec.get("attrs", {})
+            if rec.get("name") == "decode_tick":
+                filtered.append({**rec, "attrs": {
+                    **attrs,
+                    "reqs": [r for r in attrs.get("reqs", ())
+                             if r in members],
+                }})
+            elif "req" in attrs:
+                if attrs["req"] in members:
+                    filtered.append(rec)
+            else:
+                filtered.append(rec)
+        alone = derive_request_slo(filtered, group_by=lambda rid: cls)
+        assert alone[cls] == grouped[cls], cls
+
+    # The shared substrate: per-request sample map covers every served
+    # request, TTFT totals match the global derivation.
+    samples = request_slo_samples(tracer.records)
+    assert sorted(samples) == list(range(6))
+    ttft, itl = derive_request_slo(tracer.records)
+    assert ttft == stats.ttft and itl == stats.itl  # ungrouped unchanged
+    # Callable group_by; None drops a request from every group.
+    partial = derive_request_slo(tracer.records,
+                                 group_by=lambda rid: "x" if rid < 2
+                                 else None)
+    assert set(partial) == {"x"} and partial["x"][0].steps == 2
+
+
 # -- in-graph health vs jax.grad oracle -------------------------------------
 
 

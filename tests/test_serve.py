@@ -641,6 +641,65 @@ def test_engine_rejects_bad_prefix_and_chunk_configs():
                                         **bad))
 
 
+def test_scheduler_pressure_probe_matches_registry_gauges():
+    """ISSUE 8 satellite: ``Scheduler.pressure()`` is pinned EQUAL to
+    the per-tick registry gauges (occupied/active slots, queue depth,
+    free pages, prefix-pool residency) after every tick of an
+    externally-driven run — the router reads load through one probe,
+    never private state — and the begin/submit/tick/collect form
+    produces exactly ``run``'s completions (run IS that sequence)."""
+    from ddl_tpu.obs import MetricRegistry
+
+    cfg = ServeConfig(spec=SPEC, slots=2, capacity=32, page_size=8,
+                      num_pages=8, prefix_slots=2)
+    eng = InferenceEngine(cfg)
+    reg = MetricRegistry()
+    sched = Scheduler(eng, registry=reg)
+    prompts = synthesize_prompts(num=4, min_len=4, max_len=7,
+                                 vocab=SPEC.vocab, seed=21)
+    reqs = [Request(id=i, prompt=p, max_new_tokens=3)
+            for i, p in enumerate(prompts)]
+    sched.begin()
+    for r in reqs:
+        sched.submit(r)
+    pr = sched.pressure()
+    assert pr.waiting_eligible == 4 and pr.occupied_slots == 0
+    assert pr.pending_total == 4 and pr.outstanding == 4
+    assert pr.pages_free == eng.num_pages
+    ticks = 0
+    while not sched.idle:
+        sched.tick()
+        ticks += 1
+        pr = sched.pressure()
+        assert pr.occupied_slots == reg.gauge("serve_occupied_slots").value()
+        assert pr.active_slots == reg.gauge("serve_active_slots").value()
+        assert pr.waiting_eligible == reg.gauge("serve_queue_depth").value()
+        assert pr.pages_free == reg.gauge("serve_kv_pages_free").value()
+        assert pr.prefix_entries == \
+            reg.gauge("serve_prefix_pool_entries").value()
+        assert pr.pages_available <= pr.pages_free
+    done, stats = sched.collect()
+    assert ticks > 0 and sorted(done) == [0, 1, 2, 3]
+    assert stats.decode_tokens > 0
+    # The probe is quiescent again, and run() on a fresh engine (same
+    # machinery, one call) reproduces the driven run's tokens.
+    assert sched.pressure().occupied_slots == 0
+    fresh = Scheduler(InferenceEngine(cfg))
+    done2, _ = fresh.run(reqs)
+    assert {i: done[i].tokens for i in done} == \
+        {i: done2[i].tokens for i in done2}
+    # Lifecycle guards: tick/collect need an armed run; begin can't
+    # stack; release() disarms an aborted run.
+    with pytest.raises(RuntimeError, match="begin"):
+        sched.tick()
+    sched.begin()
+    with pytest.raises(RuntimeError, match="already armed"):
+        sched.begin()
+    sched.release()
+    sched.begin()
+    sched.release()
+
+
 # -- long sweeps (excluded from tier-1 via -m 'not slow') --------------------
 
 
