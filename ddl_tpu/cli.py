@@ -179,6 +179,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps (default 10; one batched device->host read "
                         "at a span boundary — never a per-step sync); "
                         "requires --metrics-out")
+    p.add_argument("--prom-port", type=int, default=None, metavar="PORT",
+                   help="serve the live metric registry over HTTP from a "
+                        "stdlib daemon thread: GET /metrics returns the "
+                        "Prometheus text exposition (byte-identical to the "
+                        "in-process prometheus_text()), GET /healthz a "
+                        "liveness JSON. PORT 0 binds an ephemeral port "
+                        "(printed at startup). Works with or without "
+                        "--metrics-out (a registry is created either way)")
+    p.add_argument("--peak-flops", type=float, default=None, metavar="FLOPS",
+                   help="per-device peak FLOP/s for the train_mfu/serve_mfu "
+                        "gauges (ddl_tpu.obs.cost): overrides the built-in "
+                        "device-kind table (TPU v2-v5 bf16 peaks; unknown "
+                        "kinds and CPU fall back to a documented nominal "
+                        "anchor so CPU runs still produce a number)")
     p.add_argument("--trace-dir", default=None, metavar="DIR",
                    help="capture a structured trace into DIR: host spans/"
                         "request-lifecycle events as host_trace_p*.jsonl "
@@ -393,6 +407,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "NAME:rate=R,pmin=A,pmax=B,new=T"
                          "[,families=F,fprefix=L]. Default: the "
                          "three-class chat/longdoc/bulk mix at horizon 32")
+    sv.add_argument("--slo-rules", default=None, metavar="SPEC",
+                    help="streaming burn-rate SLO monitors "
+                         "(ddl_tpu.obs.slo) evaluated once per scheduler/"
+                         "router tick against the live registry: ';'-joined "
+                         "NAME:metric=M,... segments with target=SECONDS "
+                         "(histogram mode: samples above the target are "
+                         "misses) or total=COUNTER (counter mode: metric "
+                         "counts bad events, total the attempts), plus "
+                         "objective=, fast=/slow= (window ticks), "
+                         "threshold=, and label.K=V series selectors. "
+                         "Emits slo_burn_rate{rule=,window=} gauges, "
+                         "slo_alerts_total{rule=} counters and slo_alert "
+                         "trace events. Under --replicas the monitor "
+                         "reads the ROUTER registry: histogram rules "
+                         "must target router_ttft_seconds with "
+                         "label.class= (observed live per global tick); "
+                         "serve_* histograms live in per-replica "
+                         "registries and are invisible to it")
     sv.add_argument("--slo", default=None, metavar="SPEC",
                     help="per-class SLO targets/priorities for "
                          "--replicas: ';'-joined NAME:ttft=S,itl=S,"
@@ -634,7 +666,7 @@ _SERVE_ONLY_DESTS = (
     "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
     "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
     "prefill_budget", "ttft_deadline", "request_deadline", "shed_threshold",
-    "replicas", "traffic", "slo",
+    "replicas", "traffic", "slo", "slo_rules",
 )
 
 
@@ -649,10 +681,17 @@ def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
     pieces directly because their profiler bracket must exclude AOT
     compilation)."""
     registry = writer = tracer = None
-    if args.metrics_out:
-        from .obs import MetricRegistry, MetricsWriter, run_manifest
+    # A registry exists whenever anything consumes it live: the JSONL
+    # writer, the /metrics pull endpoint, or an SLO monitor (ISSUE 10 —
+    # the latter two work without --metrics-out).
+    if args.metrics_out or args.prom_port is not None \
+            or getattr(args, "slo_rules", None):
+        from .obs import MetricRegistry
 
         registry = MetricRegistry()
+    if args.metrics_out:
+        from .obs import MetricsWriter, run_manifest
+
         writer = MetricsWriter(
             args.metrics_out, registry,
             run_manifest(config=config, mesh=mesh,
@@ -663,6 +702,57 @@ def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
 
         tracer = Tracer(host_trace_file(args.trace_dir))
     return registry, writer, tracer
+
+
+def _start_exporter(args, registry):
+    """``--prom-port``: launch the /metrics + /healthz pull endpoint
+    (obs.export) on the run's registry. Returns the started exporter
+    (close it in the run's ``finally``) or None when the flag is off."""
+    if args.prom_port is None:
+        return None
+    from .obs.export import MetricsExporter
+
+    try:
+        exp = MetricsExporter(registry, args.prom_port).start()
+    except OSError as e:
+        raise SystemExit(f"--prom-port {args.prom_port}: {e}")
+    print(f"[ddl_tpu] metrics endpoint: {exp.url('/metrics')} "
+          f"(healthz: {exp.url('/healthz')})")
+    return exp
+
+
+def _make_slo_monitor(args, registry, tracer=None):
+    """``--slo-rules``: build the streaming burn-rate monitor
+    (obs.slo) over the run's registry; None when the flag is off."""
+    if not getattr(args, "slo_rules", None):
+        return None
+    from .obs.slo import SloMonitor, parse_slo_rules
+
+    try:
+        rules = parse_slo_rules(args.slo_rules)
+        return SloMonitor(rules, registry, tracer=tracer)
+    except ValueError as e:
+        raise SystemExit(f"--slo-rules: {e}")
+
+
+def _slo_report(monitor):
+    """End-of-run ``--slo-rules`` surface, shared by the single-engine
+    and router serve paths: print one line per rule and return the
+    JSON digest dict (None without a monitor)."""
+    if monitor is None:
+        return None
+    digest = {}
+    for name in sorted(r.name for r in monitor.rules):
+        row = {
+            "fast_burn": monitor.burn_rate(name, "fast"),
+            "slow_burn": monitor.burn_rate(name, "slow"),
+            "alerts": monitor.alerts(name),
+            "fired_ticks": monitor.fired_ticks(name),
+        }
+        digest[name] = row
+        print(f"slo rule {name}: burn fast {row['fast_burn']:.2f} slow "
+              f"{row['slow_burn']:.2f} | alerts {row['alerts']}")
+    return digest
 
 
 def _make_injector(args, variant: str):
@@ -824,6 +914,7 @@ def _run_lm(args) -> int:
         # keeps its traceback (round-4 advisor).
         raise SystemExit(f"lm config error: {e}")
     registry, writer, tracer = _build_obs(args, config=cfg, mesh=trainer.mesh)
+    exporter = _start_exporter(args, registry)
     try:
         result = trainer.train(
             checkpoint_dir=args.checkpoint_dir,
@@ -840,6 +931,7 @@ def _run_lm(args) -> int:
             tracer=tracer,
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=injector,
+            peak_flops=args.peak_flops,
         )
         if registry is not None:
             registry.gauge("train_final_accuracy").set(result.final_accuracy)
@@ -852,6 +944,8 @@ def _run_lm(args) -> int:
         # Close on ANY exit path with a live interpreter, so a crashed
         # run still ends with a forced final snapshot (the timeout path
         # os._exits by contract — its backend is wedged in native code).
+        if exporter is not None:
+            exporter.close()
         if tracer is not None:
             tracer.close()
         if writer is not None:
@@ -966,34 +1060,48 @@ def _run_serve_router(args, cfg) -> int:
         # keep=True: the per-class SLO derivation reads the records
         # back, in addition to streaming them to the trace file.
         tracer = Tracer(host_trace_file(args.trace_dir), keep=True)
+    monitor = _make_slo_monitor(args, registry, tracer)
     injector = _make_injector(args, "serve")
     try:
         router = (
             Router.from_checkpoint(rcfg, ckpt, registry=registry,
-                                   tracer=tracer, injector=injector)
+                                   tracer=tracer, injector=injector,
+                                   slo_monitor=monitor,
+                                   peak_flops=args.peak_flops)
             if ckpt is not None else
             Router(rcfg, registry=registry, tracer=tracer,
-                   injector=injector)
+                   injector=injector, slo_monitor=monitor,
+                   peak_flops=args.peak_flops)
         )
     except (ValueError, KeyError) as e:
         raise SystemExit(f"serve config error: {e}")
     if ckpt is not None:
         print(f"[ddl_tpu] serving params from {ckpt} (params-only load, "
               f"placed once for {args.replicas} replicas)")
-    # Compile outside the reported run (every replica may receive any
-    # request, so each warms on the whole stream); the XLA timeline
-    # starts after warmup, exactly like the single-engine path.
-    router.warmup(traffic)
     from .utils.metrics import trace as profiler_trace
 
+    # Exporter starts inside the guarded block (after the ctor, which
+    # can SystemExit on config errors) so no exit path leaks the bound
+    # port or its daemon thread — and before warmup, so a scraper sees
+    # the compile ladder's xla_compiles_total live.
+    exporter = None
     try:
+        exporter = _start_exporter(args, registry)
+        # Compile outside the reported run (every replica may receive
+        # any request, so each warms on the whole stream); the XLA
+        # timeline starts after warmup, exactly like the single-engine
+        # path.
+        router.warmup(traffic)
         with profiler_trace(args.trace_dir):
             done, rstats = router.run(traffic)
     finally:
+        if exporter is not None:
+            exporter.close()
         if tracer is not None:
             tracer.close()
         if writer is not None:
             writer.close()
+    slo_digest = _slo_report(monitor)
     cls_of = {m.id: m.traffic_class for m in traffic}
     summary = rstats.summary()
     for name, row in summary["per_class"].items():
@@ -1015,6 +1123,7 @@ def _run_serve_router(args, cfg) -> int:
             "config": dataclasses.asdict(cfg),
             "replicas": args.replicas,
             "router": summary,
+            "slo_rules": slo_digest,
             "per_class": _class_tallies(done, cls_of),
             "completions": {
                 str(i): {"prompt_len": done[i].prompt_len,
@@ -1123,6 +1232,7 @@ def _run_serve(args) -> int:
     registry, writer, _ = _build_obs(
         args, config=cfg, mesh=engine.mesh, make_tracer=False
     )
+    monitor = _make_slo_monitor(args, registry)
     injector = _make_injector(args, "serve")
     try:
         scheduler = Scheduler(
@@ -1131,27 +1241,42 @@ def _run_serve(args) -> int:
             deadline_s=args.request_deadline,
             shed_threshold=args.shed_threshold,
             injector=injector,
+            slo_monitor=monitor,
+            peak_flops=args.peak_flops,
         )
     except ValueError as e:
         raise SystemExit(f"serve config error: {e}")
-    # Compile outside the reported run: the printed/JSON latency
-    # percentiles and tok/s must measure serving, not jit (the shared
-    # serve_bench/BASELINE.md methodology). Warmup also suppresses
-    # telemetry, so the trace/metrics see only the reported run.
-    scheduler.warmup(requests)
     from .obs.trace import trace_context
 
+    # Exporter starts inside the guarded block (after the ctor, which
+    # can SystemExit on config errors) so no exit path leaks the bound
+    # port or its daemon thread — and before warmup, so a scraper sees
+    # the compile ladder's xla_compiles_total live.
+    exporter = None
     try:
+        exporter = _start_exporter(args, registry)
+        # Compile outside the reported run: the printed/JSON latency
+        # percentiles and tok/s must measure serving, not jit (the
+        # shared serve_bench/BASELINE.md methodology). Warmup also
+        # suppresses telemetry, so the trace/metrics see only the
+        # reported run.
+        scheduler.warmup(requests)
         # --trace-dir: ONE context scopes both timelines — the host
         # request-lifecycle spans and the jax.profiler XLA timeline
         # land in the same directory for the same bracket (and the
         # profiler starts only now, after warmup's compilation).
         with trace_context(args.trace_dir) as tracer:
             scheduler.tracer = tracer
+            if monitor is not None:
+                # slo_alert events land in the run-scoped trace.
+                monitor.tracer = tracer
             done, stats = scheduler.run(requests)
     finally:
+        if exporter is not None:
+            exporter.close()
         if writer is not None:
             writer.close()
+    slo_digest = _slo_report(monitor)
     for i in sorted(done):
         c = done[i]
         tag = "" if c.status == "ok" else f" [{c.status}]"
@@ -1192,6 +1317,7 @@ def _run_serve(args) -> int:
             "per_class": _class_tallies(
                 done, {r.id: r.traffic_class for r in requests}
             ),
+            "slo_rules": slo_digest,
             "prefill_tokens_per_s": stats.prefill_tokens_per_s,
             "decode_tokens_per_s_per_slot":
                 stats.decode_tokens_per_s_per_slot,
@@ -1354,6 +1480,7 @@ def main(argv: list[str] | None = None) -> int:
     registry, writer, tracer = _build_obs(
         args, config=cfg, mesh=getattr(trainer, "mesh", None)
     )
+    exporter = _start_exporter(args, registry)
     obs_kwargs = {}
     run_span = contextlib.nullcontext()
     if args.variant == "single":
@@ -1366,6 +1493,7 @@ def main(argv: list[str] | None = None) -> int:
             metrics_writer=writer, tracer=tracer,
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=_make_injector(args, "single"),
+            peak_flops=args.peak_flops,
         )
     elif tracer is not None:
         # sync/async: the trainers take no tracer, but --trace-dir must
@@ -1391,11 +1519,32 @@ def main(argv: list[str] | None = None) -> int:
                 registry.gauge("train_run_images_per_sec").set(
                     result.images_per_sec
                 )
+                if args.variant != "single" and result.train_time_s > 0:
+                    # sync/async report summary-level telemetry only
+                    # (their span loops predate the obs layer): one
+                    # end-of-run MFU from the analytic per-image FLOPs
+                    # and the run-average throughput (obs.cost).
+                    import jax
+
+                    from .obs import cost as _cost
+
+                    registry.gauge("train_mfu").set(_cost.mfu(
+                        _cost.cnn_train_step_flops(
+                            1, cfg.conv_channels, cfg.fc_sizes
+                        ) * result.images_per_sec * result.train_time_s,
+                        result.train_time_s,
+                        max(1, cfg.num_workers),
+                        _cost.peak_flops_per_device(
+                            jax.devices()[0], args.peak_flops
+                        ),
+                    ))
     except AcceleratorTimeout as e:
         return _fatal_timeout(e)
     finally:
         # Any exit path with a live interpreter still forces a final
         # snapshot (the timeout path os._exits by contract).
+        if exporter is not None:
+            exporter.close()
         if tracer is not None:
             tracer.close()
         if writer is not None:

@@ -23,9 +23,31 @@ built entirely on such telemetry). Three pieces, one package:
   output and fetched batched, so the hot path never gains a device
   sync.
 
+The live SLO control plane (ISSUE 10) adds four more:
+
+- :mod:`ddl_tpu.obs.slo` — streaming multi-window burn-rate monitors
+  (``SloRule``/``SloMonitor``) evaluated per scheduler/router tick
+  against the registry, emitting ``slo_burn_rate`` gauges,
+  ``slo_alerts_total`` counters and ``slo_alert`` trace events.
+- :mod:`ddl_tpu.obs.cost` — exact analytic FLOPs for the LM/CNN train
+  steps and per-token serve work (paged-aware), the device peak-FLOPs
+  table, and the ``mfu()`` division behind the ``train_mfu`` /
+  ``serve_mfu`` gauges.
+- :mod:`ddl_tpu.obs.memory` — device memory watermark gauges (guarded
+  ``memory_stats()``) and the ``xla_compiles_total`` compile-activity
+  counter every trainer/engine program build feeds.
+- :mod:`ddl_tpu.obs.export` — the stdlib-threaded ``/metrics`` +
+  ``/healthz`` HTTP pull endpoint behind CLI ``--prom-port``.
+
 Everything is surfaced by ``cli.py`` via ``--metrics-out``,
-``--metrics-interval`` and ``--trace-dir`` (README "Observability").
+``--metrics-interval``, ``--trace-dir``, ``--prom-port``,
+``--peak-flops`` and ``--slo-rules`` (README "Observability").
 """
 
-from .registry import MetricRegistry, MetricsWriter, run_manifest  # noqa: F401
+from .registry import (  # noqa: F401
+    MetricRegistry,
+    MetricsWriter,
+    NoSamplesError,
+    run_manifest,
+)
 from .trace import NULL_TRACER, Tracer, trace_context  # noqa: F401

@@ -1,0 +1,114 @@
+"""HTTP pull endpoint for the metric registry (ISSUE 10 tentpole piece
+4): ``GET /metrics`` serves ``MetricRegistry.prometheus_text()`` and
+``GET /healthz`` a liveness JSON, from a stdlib ``ThreadingHTTPServer``
+in a daemon thread — no dependencies, CLI flag ``--prom-port``.
+
+The JSONL ``MetricsWriter`` is a push artifact read after the run; the
+pull endpoint is what a live scraper (Prometheus, the PR-11 autoscaler,
+an operator's ``curl``) reads DURING the run. The body is byte-for-byte
+the in-process ``prometheus_text()`` (pinned mid-run in
+tests/test_slo.py) — the endpoint adds transport, never a second
+formatting path.
+
+Threading: the handler thread reads registry state the run loop
+mutates. Python-level dict/list operations are GIL-atomic, but
+ITERATING a dict while the run loop inserts a new series raises
+``RuntimeError: dictionary changed size`` — the handler retries the
+snapshot a few times (new-series insertion is rare after startup) and
+degrades to 503 rather than ever crashing the serving thread. Port 0
+binds an ephemeral port (the tests' race-free choice); the bound port
+is exposed as ``.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import MetricRegistry
+
+_SNAPSHOT_RETRIES = 5
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serve ``registry`` at ``http://{host}:{port}``. ``start()``
+    launches the daemon thread and returns self; ``close()`` shuts the
+    server down (idempotent). Context-manager friendly."""
+
+    def __init__(self, registry: MetricRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        if registry is None:
+            raise ValueError("MetricsExporter needs a MetricRegistry")
+        self.registry = registry
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 — silence
+                pass  # no stray stdout from the handler thread
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    for attempt in range(_SNAPSHOT_RETRIES):
+                        try:
+                            body = exporter.registry.prometheus_text() \
+                                .encode("utf-8")
+                            break
+                        except RuntimeError:
+                            # The run loop inserted a series mid-walk;
+                            # re-snapshot (module docstring).
+                            if attempt == _SNAPSHOT_RETRIES - 1:
+                                self._send(
+                                    503,
+                                    b"snapshot raced registry mutation\n",
+                                    "text/plain",
+                                )
+                                return
+                    self._send(200, body, _CONTENT_TYPE)
+                elif path == "/healthz":
+                    self._send(
+                        200,
+                        json.dumps({"status": "ok"}).encode() + b"\n",
+                        "application/json",
+                    )
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = int(self._server.server_port)
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MetricsExporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="ddl-tpu-metrics-exporter", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

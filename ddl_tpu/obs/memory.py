@@ -1,0 +1,111 @@
+"""Device memory watermarks + compile-activity counters (ISSUE 10
+tentpole piece 3).
+
+Two resource signals the live control plane needs that nothing
+published before:
+
+- **Memory watermarks**: ``device.memory_stats()`` (the PJRT allocator
+  counters — ``bytes_in_use``, ``peak_bytes_in_use``, ...) sampled into
+  ``device_memory_bytes_in_use{device=}`` / ``device_memory_peak_bytes
+  {device=}`` gauges. The call is a HOST query of allocator state — no
+  device sync, no dispatch — but not every backend implements it (this
+  container's XLA:CPU returns ``None``), so :class:`MemorySampler`
+  probes once and disables itself on unsupported backends: after the
+  first empty probe a sample is one attribute check. Trainers sample on
+  the existing ``--metrics-interval`` span boundary, the serve
+  scheduler on its tick (already host-paced) — the hot path gains zero
+  new device syncs either way.
+- **Compile activity**: every DISTINCT program build — a trainer span
+  program's ``lower().compile()``, an engine prefill/decode bucket, a
+  prefix-copy program — increments ``xla_compiles_total{kind=}`` and
+  traces a ``compile`` record. A mid-run recompile (a guard rollback
+  realigning spans, a decode bucket the warmup ladder missed) is
+  exactly the latency incident this makes auditable. Engine programs
+  are counted at BUILD time (each cached program serves exactly one
+  shape signature, so builds and XLA compiles are 1:1); trainer builds
+  carry the real compile bracket as a span.
+"""
+
+from __future__ import annotations
+
+
+def device_memory_stats(device) -> dict | None:
+    """``device.memory_stats()`` guarded for backends that lack it or
+    return None/empty (XLA:CPU here) — any failure is 'no data', never
+    an exception on the metrics path."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — unsupported backend is a fine answer
+        return None
+    return dict(stats) if stats else None
+
+
+class MemorySampler:
+    """Samples memory watermark gauges for ``devices`` into
+    ``registry``. The first sample that finds NO device reporting stats
+    latches the sampler off (``supported = False``), so unsupported
+    backends pay one probe total."""
+
+    def __init__(self, registry, devices):
+        self.registry = registry
+        self.devices = list(devices)
+        self.supported: bool | None = None  # None = not yet probed
+
+    def sample(self) -> bool:
+        """Record current watermarks; returns True when any device
+        reported. No-op (False) once latched unsupported."""
+        if self.supported is False:
+            return False
+        any_stats = False
+        for i, dev in enumerate(self.devices):
+            stats = device_memory_stats(dev)
+            if stats is None:
+                continue
+            any_stats = True
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                self.registry.gauge(
+                    "device_memory_bytes_in_use",
+                    "live allocator bytes per device",
+                ).set(int(in_use), device=i)
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                self.registry.gauge(
+                    "device_memory_peak_bytes",
+                    "high-watermark allocator bytes per device",
+                ).set(int(peak), device=i)
+            limit = stats.get("bytes_limit")
+            if limit is not None:
+                self.registry.gauge(
+                    "device_memory_bytes_limit",
+                    "allocator capacity per device",
+                ).set(int(limit), device=i)
+        if self.supported is None:
+            self.supported = any_stats
+        return any_stats
+
+
+def record_compile(registry, tracer, kind: str, *,
+                   t0: float | None = None, t1: float | None = None,
+                   **attrs) -> None:
+    """Count one program build (``xla_compiles_total{kind=}``) and
+    trace it — a real ``compile`` span when the caller measured the
+    bracket (trainer AOT builds), an instant event otherwise (engine
+    lazy builds, whose XLA compile happens inside the first dispatch).
+    ``registry``/``tracer`` may each be None/falsy — partial telemetry
+    records what it can."""
+    if registry is not None:
+        registry.counter(
+            "xla_compiles_total",
+            "distinct compiled programs built, by kind",
+        ).inc(kind=kind)
+        if t0 is not None and t1 is not None:
+            registry.histogram(
+                "xla_compile_seconds",
+                "wall seconds per measured program build",
+            ).observe(t1 - t0, kind=kind)
+    if tracer:
+        if t0 is not None and t1 is not None:
+            tracer.complete("compile", t0, t1, kind=kind, **attrs)
+        else:
+            tracer.event("compile", kind=kind, **attrs)

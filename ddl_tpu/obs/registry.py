@@ -48,6 +48,24 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double-quote and newline must be escaped or a value containing any
+    of them silently corrupts the scrape (ISSUE 10 satellite; pinned
+    with all three characters in tests/test_obs.py). Order matters —
+    backslash first, or the other escapes' backslashes double."""
+    return (value.replace("\\", r"\\")
+            .replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+class NoSamplesError(LookupError):
+    """``Histogram.percentile`` was asked about a label set that holds
+    no samples — an empty registry, or a label set that was never
+    observed (a typo'd label silently reading 0.0 was the bug this
+    replaces; ISSUE 10 satellite)."""
+
+
 class _Metric:
     kind = "?"
 
@@ -100,16 +118,32 @@ class Histogram(_Metric):
     def values(self, **labels) -> list[float]:
         return list(self._series.get(_label_key(labels), ()))
 
+    def values_since(self, start: int, **labels) -> tuple[int, list[float]]:
+        """``(total_count, samples[start:])`` for one label set — the
+        incremental consumer's read (obs.slo ticks every scheduler
+        step; copying the WHOLE series each tick would be O(history),
+        this copies only the tail)."""
+        vals = self._series.get(_label_key(labels), ())
+        return len(vals), list(vals[start:])
+
     def count(self, **labels) -> int:
         return len(self._series.get(_label_key(labels), ()))
 
     def percentile(self, q: float, **labels) -> float:
         """Raw-unit percentile over the observed samples —
         ``np.percentile``'s linear interpolation, the SAME definition
-        ``StepStats.from_times`` uses (parity pinned in test_obs)."""
+        ``StepStats.from_times`` uses (parity pinned in test_obs).
+        Raises :class:`NoSamplesError` when the label set holds no
+        samples — a percentile of nothing is a question error, not 0.0
+        (``stats()`` keeps its zero-filled ``StepStats`` contract for
+        aggregate reporting)."""
         vals = self._series.get(_label_key(labels))
         if not vals:
-            return 0.0
+            raise NoSamplesError(
+                f"histogram {self.name!r} has no samples for label set "
+                f"{dict(labels)!r} (observed label sets: "
+                f"{self.label_sets()!r})"
+            )
         return float(np.percentile(np.asarray(vals, np.float64), q))
 
     def stats(self, **labels) -> StepStats:
@@ -186,7 +220,10 @@ class MetricRegistry:
             items = {**labels, **(extra or {})}
             if not items:
                 return ""
-            body = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+            body = ",".join(
+                f'{k}="{_escape_label_value(str(v))}"'
+                for k, v in sorted(items.items())
+            )
             return "{" + body + "}"
 
         lines = []

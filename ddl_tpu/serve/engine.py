@@ -285,6 +285,17 @@ class InferenceEngine:
             self.params = multihost.put_tree(self.mesh, self._pspecs,
                                              params)
         self._row_reduce = coll.tp_allreduce(TP_AXIS) if tp > 1 else None
+        # Compile-activity hook (ISSUE 10, obs/memory.py): called as
+        # ``hook(kind, key)`` at every DISTINCT program build — each
+        # cached program serves exactly one shape signature, so builds
+        # and XLA compiles are 1:1. None (the default) is a no-op; the
+        # scheduler attaches a registry-backed hook when telemetry is
+        # on, so the off path is unchanged.
+        self.compile_hook = None
+        # The width the LAST decode attended per slot (paged: the
+        # page-count bucket's rows; contiguous: the fixed capacity) —
+        # the paged-aware denominator of serve_flops_per_token.
+        self.last_attend_width = config.capacity
         self._prefill_fns: dict[int, object] = {}
         self._decode_fn = None
         self._decode_paged_fns: dict[int, object] = {}
@@ -305,6 +316,12 @@ class InferenceEngine:
         the loaded host tree). Same params-only contract as
         :meth:`load_params`."""
         return cls(config, params=_load_host_params(path, config.spec))
+
+    def _note_compile(self, kind: str, key: int) -> None:
+        """One distinct program was just built (engine.__init__
+        docstring for the hook contract)."""
+        if self.compile_hook is not None:
+            self.compile_hook(kind, key)
 
     # -- state -------------------------------------------------------------
 
@@ -434,6 +451,7 @@ class InferenceEngine:
                     ),
                     donate_argnums=donation_for(self.mesh, 0),
                 )
+                self._note_compile("pages_reset", 0)
             self.cache = self._reset_pages_fn(self.cache, jnp.asarray(ids))
 
     def release_slot(self, slot: int) -> None:
@@ -568,6 +586,7 @@ class InferenceEngine:
 
         fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
         self._prefill_fns[bucket] = fn
+        self._note_compile("prefill", bucket)
         return fn
 
     def _decode(self):
@@ -605,6 +624,7 @@ class InferenceEngine:
         self._decode_fn = jax.jit(
             run, donate_argnums=donation_for(self.mesh, 1)
         )
+        self._note_compile("decode", 0)
         return self._decode_fn
 
     # -- paged compiled programs -------------------------------------------
@@ -662,6 +682,7 @@ class InferenceEngine:
 
         fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
         self._prefill_fns[bucket] = fn
+        self._note_compile("prefill", bucket)
         return fn
 
     def _decode_paged(self, pages: int):
@@ -706,6 +727,7 @@ class InferenceEngine:
 
         fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
         self._decode_paged_fns[pages] = fn
+        self._note_compile("decode", pages)
         return fn
 
     def _copy_page(self):
@@ -729,6 +751,7 @@ class InferenceEngine:
         self._copy_page_fn = jax.jit(
             shard, donate_argnums=donation_for(self.mesh, 0)
         )
+        self._note_compile("prefix_copy", 0)
         return self._copy_page_fn
 
     def decode_page_bucket(self, pages: int) -> int:
@@ -776,6 +799,7 @@ class InferenceEngine:
             self._copy_in = fn
         else:
             self._copy_out = fn
+        self._note_compile("prefix_copy", int(into_cache))
         return fn
 
     def prefix_fetch(self, entry_id: int, n: int, slot: int) -> int:
@@ -953,6 +977,7 @@ class InferenceEngine:
                 pb = self.decode_page_bucket(widest)
             else:
                 pb = _pages
+            self.last_attend_width = pb * self.page_size
             nxt, logits, self.cache = self._decode_paged(pb)(
                 self.params, self.cache,
                 jnp.asarray(np.asarray(last_tokens, np.int32)),

@@ -216,7 +216,8 @@ class Router:
     own."""
 
     def __init__(self, config: RouterConfig, params=None, *,
-                 registry=None, tracer=None, injector=None):
+                 registry=None, tracer=None, injector=None,
+                 slo_monitor=None, peak_flops: float | None = None):
         if config.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1, got {config.replicas}"
@@ -240,6 +241,19 @@ class Router:
                         f"{config.shed_threshold} (threshold - margin must "
                         "be >= 1)"
                     )
+        if slo_monitor is not None:
+            if registry is None:
+                raise ValueError(
+                    "slo_monitor needs the router registry it evaluates "
+                    "against; pass registry= as well"
+                )
+            if slo_monitor.registry is not registry:
+                raise ValueError(
+                    "slo_monitor was built on a different registry than "
+                    "this router's — it would read counters the router "
+                    "never writes (burn 0.0 forever). Build it on the "
+                    "registry passed as registry="
+                )
         self.config = config
         self.classes = {c.name: c for c in config.classes}
         self.tracer = tracer if tracer is not None else Tracer()
@@ -276,9 +290,20 @@ class Router:
                 registry=regs[k], shed_threshold=config.shed_threshold,
                 ttft_deadline_s=config.ttft_deadline_s,
                 deadline_s=config.deadline_s, injector=injector,
+                peak_flops=peak_flops,
             )
             for k, eng in enumerate(self.engines)
         ]
+        # Live SLO monitor (ISSUE 10): advanced once per GLOBAL tick in
+        # run() — router-level rules read the router registry (validated
+        # identical above, before the engines were built): counter-mode
+        # over the {class=}-labeled shed/request counters, histogram-
+        # mode over router_ttft_seconds{class=}, which run() observes
+        # LIVE at each first token (serve_* histograms land in the
+        # per-replica registries and are invisible here). The
+        # per-replica schedulers keep slo_monitor=None: one clock, one
+        # evaluator.
+        self.slo_monitor = slo_monitor
         self._sticky: dict[bytes, int] = {}
 
     @classmethod
@@ -375,6 +400,16 @@ class Router:
                counters: dict) -> None:
         cls = self.classes[req.traffic_class]
         cls_of[req.id] = cls.name
+        if self.registry is not None:
+            # EVERY arrival is an attempt — counted BEFORE the shed
+            # decision, or the canonical shed-fraction SLO rule
+            # (router_shed_total over router_requests_total) would read
+            # burn 0.0 in an all-shed window: sheds with no admits
+            # would leave the attempts denominator empty exactly when
+            # the overload is worst.
+            self.registry.counter("router_requests_total").inc(
+                **{"class": cls.name}
+            )
         pressures = [s.pressure() for s in self.scheds]
         if self.config.shed_threshold is not None:
             shed_at = self.config.shed_threshold - cls.margin
@@ -415,9 +450,6 @@ class Router:
                               replica=replica, reason=reason,
                               cls=cls.name)
         if self.registry is not None:
-            self.registry.counter("router_requests_total").inc(
-                **{"class": cls.name}
-            )
             self.registry.counter(
                 "router_affinity_placements_total" if reason == "affinity"
                 else "router_load_placements_total"
@@ -453,6 +485,16 @@ class Router:
         t = 0
         i = 0
         ticks = 0
+        # Live per-class TTFT (ISSUE 10): the shared tracer's records
+        # are append-only, so an incremental scan per global tick pairs
+        # each new `first_token` with its `eligible` — the SAME
+        # definition request_slo_samples derives post-hoc — and
+        # observes router_ttft_seconds{class=} BEFORE the monitor
+        # tick. This is what makes histogram-mode SLO rules live in
+        # router mode (serve_* histograms land in the per-replica
+        # registries, invisible to the router's monitor).
+        scanned = rec_start
+        eligible_t: dict[int, float] = {}
         try:
             while i < len(reqs) or any(not s.idle for s in self.scheds):
                 while i < len(reqs) and reqs[i].arrival <= t:
@@ -462,12 +504,37 @@ class Router:
                     if not sched.idle:
                         sched.tick()
                 if self.registry is not None:
+                    recs = self.tracer.records
+                    for r in recs[scanned:]:
+                        name = r.get("name")
+                        if name == "eligible":
+                            # setdefault: FIRST eligible wins, the
+                            # request_slo_samples definition.
+                            eligible_t.setdefault(
+                                r["attrs"]["req"], r["t"]
+                            )
+                        elif name == "first_token":
+                            rid = r["attrs"]["req"]
+                            if rid in eligible_t and rid in cls_of:
+                                self.registry.histogram(
+                                    "router_ttft_seconds"
+                                ).observe(
+                                    r["t"] - eligible_t.pop(rid),
+                                    **{"class": cls_of[rid]},
+                                )
+                    scanned = len(recs)
                     for k, sched in enumerate(self.scheds):
                         p = sched.pressure()
                         self.registry.gauge(
                             "router_replica_outstanding"
                         ).set(p.occupied_slots + p.pending_total,
                               replica=k)
+                if self.slo_monitor is not None:
+                    # One burn-rate window step per GLOBAL tick — the
+                    # same deterministic clock routing decisions use,
+                    # so the burst-alert scenario replays exactly
+                    # (pinned in tests/test_slo.py).
+                    self.slo_monitor.tick()
                 ticks += 1
                 t += 1
                 if i < len(reqs) and all(s.idle for s in self.scheds):
@@ -519,9 +586,11 @@ class Router:
                 else (1.0 if statuses.count("ok") else 0.0),
             )
             if self.registry is not None:
-                self.registry.histogram("router_ttft_seconds").observe_many(
-                    ttfts, **{"class": name}
-                )
+                # router_ttft_seconds was observed LIVE per global tick
+                # in run() (the incremental trace scan) — re-observing
+                # here would double-count. ITL stays post-run: per-
+                # request gap reconstruction needs the full decode_tick
+                # history.
                 self.registry.histogram("router_itl_seconds").observe_many(
                     itls, **{"class": name}
                 )

@@ -102,6 +102,8 @@ import time
 
 import numpy as np
 
+from ..obs import cost as _cost
+from ..obs.memory import MemorySampler, record_compile
 from ..obs.trace import NULL_TRACER
 from ..utils.metrics import StepStats, StepTimer
 from .engine import InferenceEngine
@@ -282,7 +284,8 @@ class Scheduler:
                  allow_window: bool = False, tracer=None, registry=None,
                  metrics_writer=None, ttft_deadline_s: float | None = None,
                  deadline_s: float | None = None,
-                 shed_threshold: int | None = None, injector=None):
+                 shed_threshold: int | None = None, injector=None,
+                 slo_monitor=None, peak_flops: float | None = None):
         self.engine = engine
         self.eos_id = eos_id
         if allow_window and engine.paged:
@@ -327,6 +330,34 @@ class Scheduler:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = registry
         self.metrics_writer = metrics_writer
+        # Live SLO control plane (ISSUE 10): an obs.slo.SloMonitor
+        # advanced once per tick (its windows are tick windows — the
+        # deterministic clock), a MemorySampler for device watermark
+        # gauges (self-latching off on backends without memory_stats),
+        # a peak-FLOPs resolution for the serve_mfu gauge, and the
+        # engine compile hook feeding xla_compiles_total. All absent
+        # when telemetry is off — the off path is byte-identical.
+        self.slo_monitor = slo_monitor
+        if slo_monitor is not None and slo_monitor.registry is not registry:
+            raise ValueError(
+                "slo_monitor was built on a different registry than this "
+                "scheduler's — it would read metrics the scheduler never "
+                "writes (burn 0.0 forever). Build it on the registry "
+                "passed as registry="
+            )
+        self._peak_flops = peak_flops
+        self._peak: float | None = None
+        self._mem = None
+        if registry is not None:
+            self._mem = MemorySampler(registry, engine.mesh.devices.flat)
+
+            def _on_build(kind, key, _sched=self):
+                # Registry captured directly (compile activity during
+                # warmup IS signal); the tracer read dynamically so
+                # warmup's suppressed tracer stays suppressed.
+                record_compile(registry, _sched.tracer, kind, key=key)
+
+            engine.compile_hook = _on_build
         # Externally-driven run state (ISSUE 8): armed by begin(),
         # advanced by tick(), finalized by collect()/release(). run()
         # is sugar over the same four primitives.
@@ -358,11 +389,17 @@ class Scheduler:
         # shed would skip compiling the programs the real run needs.
         saved = (self.tracer, self.registry, self.metrics_writer,
                  self.ttft_deadline_s, self.deadline_s,
-                 self.shed_threshold, self.injector)
+                 self.shed_threshold, self.injector, self.slo_monitor,
+                 self._mem)
         self.tracer, self.registry, self.metrics_writer = \
             NULL_TRACER, None, None
         self.ttft_deadline_s = self.deadline_s = None
         self.shed_threshold = self.injector = None
+        # The SLO monitor and memory sampler are per-TICK consumers:
+        # warmup's clone ticks must not advance burn-rate windows or
+        # sample watermarks mid-compile (the engine compile hook stays
+        # live — compile activity during warmup IS its signal).
+        self.slo_monitor = self._mem = None
         try:
             self.run([
                 dataclasses.replace(
@@ -372,82 +409,92 @@ class Scheduler:
                 )
                 for i, r in enumerate(requests)
             ])
+            # Suppression covers the COMPILE LADDERS below too, not
+            # just the clone run: the engine compile hook reads
+            # self.tracer dynamically, so a warmup build traces nothing
+            # (the "warmup emits no records" pin) while its
+            # xla_compiles_total count — registry captured directly in
+            # the hook — still lands.
+            if eng.paged:
+                # The clone run may leave prefix entries holding pages;
+                # the compile ladders below need a clean pool (a tight
+                # pool could otherwise exhaust mid-warmup). Warmup
+                # discards all engine state at the end regardless.
+                eng.reset()
+            max_bucket = eng.prefill_bucket(max(
+                int(np.asarray(r.prompt).shape[0]) for r in requests
+            ))
+            b = 8
+            while True:
+                # min() also covers a capacity-capped (non-power-of-two)
+                # top bucket the doubling ladder would step over. The
+                # 1-token prompt at a FORCED bucket compiles the program
+                # with one real row — so the paged ladder costs one
+                # page, not a worst-case table's worth.
+                bucket = min(b, max_bucket)
+                eng.prefill(np.zeros(1, np.int32), slot=0, request_id=-1,
+                            base=0, _bucket=bucket)
+                if bucket == max_bucket:
+                    break
+                b *= 2
+            if eng.paged:
+                eng.release_slot(0)
+                # Decode is keyed by PAGE-COUNT bucket: compile the
+                # ladder up to the widest residency the real run can
+                # reach (the truncated clones never grow past ~2
+                # generated tokens, so the big buckets would otherwise
+                # jit inside a timed bracket). All-inactive batches
+                # compile without moving state: every write maps out of
+                # bounds and drops.
+                top = eng.decode_page_bucket(eng.pages_needed(max(
+                    min(int(np.asarray(r.prompt).shape[0])
+                        + r.max_new_tokens, eng.config.capacity)
+                    for r in requests
+                )))
+                S = eng.config.slots
+                zeros = np.zeros(S, np.int32)
+                pb = 1
+                while True:
+                    pbi = min(pb, eng.max_pages)
+                    eng.decode(zeros, zeros, zeros, np.zeros(S, bool),
+                               _pages=pbi)
+                    if pbi >= top:
+                        break
+                    pb *= 2
+            if eng.prefix is not None:
+                if eng.paged:
+                    # The paged hit path moves no K/V rows EXCEPT the
+                    # CoW partial-tail-page copy — seed two full pages,
+                    # register (zero-copy donation), and take one
+                    # page-UNALIGNED hit so that one program compiles
+                    # here, not mid-run. Tiny pools (< 3 pages of
+                    # headroom) skip — such a run compiles it lazily on
+                    # its first unaligned hit.
+                    ps = eng.page_size
+                    if eng.max_pages >= 2 and eng.num_pages >= 3:
+                        eng.prefill(np.zeros(2 * ps, np.int32), slot=0,
+                                    request_id=-1, base=0)
+                        if eng.prefix_store(np.zeros(2 * ps, np.int32),
+                                            0):
+                            entry, _ = eng.prefix.match(
+                                np.zeros(2 * ps, np.int32)
+                            )
+                            eng.release_slot(0)
+                            eng.prefix_fetch(entry, ps + 1, 0)
+                            eng.prefix_release(entry)
+                # One store + fetch compiles both contiguous copy
+                # programs even when the truncated clone run happened
+                # to produce no hit.
+                elif eng.prefix_store(np.zeros(2, np.int32), 0):
+                    entry, _ = eng.prefix.match(np.zeros(2, np.int32))
+                    eng.prefix_fetch(entry, 2, 0)
+                    eng.prefix_release(entry)
+            self.engine.reset()
         finally:
             (self.tracer, self.registry, self.metrics_writer,
              self.ttft_deadline_s, self.deadline_s,
-             self.shed_threshold, self.injector) = saved
-        if eng.paged:
-            # The clone run may leave prefix entries holding pages; the
-            # compile ladders below need a clean pool (a tight pool
-            # could otherwise exhaust mid-warmup). Warmup discards all
-            # engine state at the end regardless.
-            eng.reset()
-        max_bucket = eng.prefill_bucket(max(
-            int(np.asarray(r.prompt).shape[0]) for r in requests
-        ))
-        b = 8
-        while True:
-            # min() also covers a capacity-capped (non-power-of-two)
-            # top bucket the doubling ladder would step over. The
-            # 1-token prompt at a FORCED bucket compiles the program
-            # with one real row — so the paged ladder costs one page,
-            # not a worst-case table's worth.
-            bucket = min(b, max_bucket)
-            eng.prefill(np.zeros(1, np.int32), slot=0, request_id=-1,
-                        base=0, _bucket=bucket)
-            if bucket == max_bucket:
-                break
-            b *= 2
-        if eng.paged:
-            eng.release_slot(0)
-            # Decode is keyed by PAGE-COUNT bucket: compile the ladder
-            # up to the widest residency the real run can reach (the
-            # truncated clones never grow past ~2 generated tokens, so
-            # the big buckets would otherwise jit inside a timed
-            # bracket). All-inactive batches compile without moving
-            # state: every write maps out of bounds and drops.
-            top = eng.decode_page_bucket(eng.pages_needed(max(
-                min(int(np.asarray(r.prompt).shape[0]) + r.max_new_tokens,
-                    eng.config.capacity)
-                for r in requests
-            )))
-            S = eng.config.slots
-            zeros = np.zeros(S, np.int32)
-            pb = 1
-            while True:
-                pbi = min(pb, eng.max_pages)
-                eng.decode(zeros, zeros, zeros, np.zeros(S, bool),
-                           _pages=pbi)
-                if pbi >= top:
-                    break
-                pb *= 2
-        if eng.prefix is not None:
-            if eng.paged:
-                # The paged hit path moves no K/V rows EXCEPT the CoW
-                # partial-tail-page copy — seed two full pages, register
-                # (zero-copy donation), and take one page-UNALIGNED hit
-                # so that one program compiles here, not mid-run. Tiny
-                # pools (< 3 pages of headroom) skip — such a run
-                # compiles it lazily on its first unaligned hit.
-                ps = eng.page_size
-                if eng.max_pages >= 2 and eng.num_pages >= 3:
-                    eng.prefill(np.zeros(2 * ps, np.int32), slot=0,
-                                request_id=-1, base=0)
-                    if eng.prefix_store(np.zeros(2 * ps, np.int32), 0):
-                        entry, _ = eng.prefix.match(
-                            np.zeros(2 * ps, np.int32)
-                        )
-                        eng.release_slot(0)
-                        eng.prefix_fetch(entry, ps + 1, 0)
-                        eng.prefix_release(entry)
-            # One store + fetch compiles both contiguous copy programs
-            # even when the truncated clone run happened to produce no
-            # hit.
-            elif eng.prefix_store(np.zeros(2, np.int32), 0):
-                entry, _ = eng.prefix.match(np.zeros(2, np.int32))
-                eng.prefix_fetch(entry, 2, 0)
-                eng.prefix_release(entry)
-        self.engine.reset()
+             self.shed_threshold, self.injector, self.slo_monitor,
+             self._mem) = saved
 
     def _validate(self, r: Request) -> None:
         """Reject a malformed request at SUBMIT time — ``run`` validates
@@ -523,6 +570,16 @@ class Scheduler:
             else self.ttft_deadline_s
         total = r.deadline_s if r.deadline_s is not None else self.deadline_s
         return ttft, total
+
+    def _resolve_peak(self) -> float:
+        """Per-device peak FLOP/s for the serve_mfu gauge: the ctor
+        override wins, else the obs.cost device-kind table (resolved
+        once)."""
+        if self._peak is None:
+            self._peak = _cost.peak_flops_per_device(
+                self.engine.mesh.devices.flat[0], self._peak_flops
+            )
+        return self._peak
 
     # -- externally-driven run form (ISSUE 8) ------------------------------
     #
@@ -971,6 +1028,16 @@ class Scheduler:
                     reg.histogram("serve_prefill_seconds").observe(
                         st.prefill_timer._times[-1]
                     )
+                    # Analytic prefill cost of the block just computed
+                    # (obs.cost, ISSUE 10): the compiled BUCKET's rows
+                    # over the cache-wide attend span, amortized per
+                    # real token — padding computes too, and the gauge
+                    # says so.
+                    reg.gauge("serve_prefill_flops_per_token").set(
+                        _cost.serve_prefill_flops(
+                            cfg.spec, eng.prefill_bucket(n), cfg.capacity
+                        ) / n
+                    )
                 st.prefilled[s] += n
                 prefilled_any = True
                 st.lengths[s] = st.prefilled[s]  # see admission comment
@@ -1032,6 +1099,19 @@ class Scheduler:
                 )
                 if chained:
                     reg.histogram("serve_itl_seconds").observe(st.itls[-1])
+                # Analytic decode cost (obs.cost, ISSUE 10): per-token
+                # FLOPs at the width this tick actually attended — the
+                # paged bucket's residency, or the contiguous capacity
+                # (the paged layout's per-token saving made visible) —
+                # and the MFU of the decode step just timed.
+                fpt = _cost.serve_decode_flops_per_token(
+                    cfg.spec, eng.last_attend_width
+                )
+                reg.gauge("serve_flops_per_token").set(fpt)
+                reg.gauge("serve_mfu").set(_cost.mfu(
+                    fpt * n_active, st.decode_timer._times[-1],
+                    int(eng.mesh.devices.size), self._resolve_peak(),
+                ))
             for s in range(S):
                 if not st.active[s]:
                     continue
@@ -1076,11 +1156,23 @@ class Scheduler:
                 reg.gauge("serve_kv_pages_shared").set(
                     eng.pages.shared
                 )
+            # Device memory watermarks (obs.memory, ISSUE 10): a host
+            # allocator query, self-latching off on backends without
+            # memory_stats — one attribute check per tick after that.
+            # None when the registry was attached POST-ctor (the bench
+            # per-rep registry swap) — watermarks are a ctor feature.
+            if self._mem is not None:
+                self._mem.sample()
             if self.metrics_writer is not None:
                 # Rate-limited internally (interval_s): the per-tick
                 # gauge HISTORY lands in the JSONL as a time series,
                 # not just the final tick's values.
                 self.metrics_writer.maybe_flush()
+        if self.slo_monitor is not None:
+            # Advance the burn-rate windows one tick (obs.slo): reads
+            # only its own registry, so runs without a monitor are
+            # untouched.
+            self.slo_monitor.tick()
         st.step = step + 1
         if all(o is None for o in st.occupant) and st.pending:
             # Idle gap before the next arrival: every intervening
@@ -1154,7 +1246,19 @@ def derive_request_slo(records, group_by=None):
     (pinned in tests/test_obs.py): per-class and per-replica breakdowns
     are the same computation, just keyed differently. Per-request ITL
     needs the ``decode_tick`` ``reqs`` attribute (present from ISSUE 8
-    on); older traces yield empty grouped ITL."""
+    on); older traces yield empty grouped ITL.
+
+    Degenerate inputs (ISSUE 10 satellite — SKIP, never raise: the
+    derivation is a read-only reporting surface and an empty run is a
+    valid run): an empty record list returns zero-filled ``StepStats``
+    ungrouped and ``{}`` grouped; a group whose members never reached a
+    first token (all shed / expired in queue) is ABSENT from the
+    grouped result — absence is the honest answer ("no latency
+    evidence"), distinct from a zero-latency entry, and matches
+    ``request_slo_samples`` covering served requests only (the router's
+    ``ClassReport`` separately counts those members as misses);
+    a callable ``group_by`` returning None drops that request from
+    every group. All three pinned in tests/test_obs.py."""
     if group_by is None:
         eligible: dict[int, float] = {}
         ttfts: list[float] = []

@@ -1238,6 +1238,7 @@ class SeqTrainer:
         max_rollbacks: int = 3,
         fault_injector=None,
         checkpoint_keep: int = 2,
+        peak_flops: float | None = None,
     ) -> LMResult:
         """Same persistence/observability contract as every other trainer:
         atomic rolling checkpoint at epoch ends (plus every
@@ -1334,6 +1335,27 @@ class SeqTrainer:
         health_on = metrics is not None
         fns: dict[int, Any] = {}
         compile_time = 0.0
+        # Live resource accounting (ISSUE 10, obs.cost/obs.memory):
+        # analytic per-step FLOPs for the train_mfu gauge (exact,
+        # config-parameterized; topology re-shards the same math so the
+        # number is mode-invariant — the mesh size enters the MFU
+        # denominator instead), the per-device peak, and a memory
+        # watermark sampler. All None/absent with metrics off — the
+        # compiled programs never change (host-side arithmetic only).
+        step_flops = n_dev = peak = mem_sampler = mfu_of = None
+        if metrics is not None:
+            from ..obs import cost as _cost
+            from ..obs.memory import MemorySampler, record_compile
+
+            mfu_of = _cost.mfu
+            step_flops = _cost.lm_train_step_flops(
+                cfg.spec, bs, ds.seq_len, remat=cfg.remat
+            )
+            n_dev = int(self.mesh.devices.size)
+            peak = _cost.peak_flops_per_device(
+                self.mesh.devices.flat[0], peak_flops
+            )
+            mem_sampler = MemorySampler(metrics, self.mesh.devices.flat)
 
         def fn_for(k: int):
             # On-demand: a guard rollback can realign spans onto
@@ -1346,14 +1368,25 @@ class SeqTrainer:
                     .lower(params, opt_state, xs, ys, ws, jnp.int32(0))
                     .compile()
                 )
-                compile_time += time.perf_counter() - tc
+                t1 = time.perf_counter()
+                compile_time += t1 - tc
+                if metrics is not None:
+                    # Compile-activity accounting (obs.memory): a build
+                    # AFTER the AOT plan (a rollback realignment) is a
+                    # mid-run latency incident — now auditable.
+                    record_compile(metrics, tracer, "train_span",
+                                   t0=tc, t1=t1, k=k)
             return fns[k]
 
         t0 = time.perf_counter()
         for k in {k for _, k, _ in spans} | {k for _, k, _ in resume_spans}:
             fn_for(k)
+        te0 = time.perf_counter()
         ev = self._eval_fn().lower(params, xte, yte, wte).compile()
         compile_time = time.perf_counter() - t0
+        if metrics is not None:
+            record_compile(metrics, tracer, "eval",
+                           t0=te0, t1=time.perf_counter())
 
         def _rollback():
             """Guard escalation: restore the newest VALID checkpoint at
@@ -1422,6 +1455,12 @@ class SeqTrainer:
                             metrics.gauge("train_tokens_per_sec").set(
                                 k * tokens_per_batch / span_s if span_s else 0.0
                             )
+                            # MFU (ISSUE 10): analytic FLOPs of the k
+                            # steps just dispatched over what the mesh
+                            # could do at peak in the measured bracket.
+                            metrics.gauge("train_mfu").set(mfu_of(
+                                step_flops * k, span_s, n_dev, peak
+                            ))
                             # The divergence tripwire reads EVERY span (a
                             # [k] int32 fetch riding the loss barrier — the
                             # span already executed, this adds no sync); the
@@ -1442,6 +1481,12 @@ class SeqTrainer:
                                     metrics, jax.device_get(hstack),
                                     include_nonfinite=False,
                                 )
+                                # Memory watermarks ride the SAME
+                                # interval boundary (obs.memory): a
+                                # host allocator query, self-latched
+                                # off where unsupported — zero new
+                                # device syncs on the hot path.
+                                mem_sampler.sample()
                             if metrics_writer is not None:
                                 metrics_writer.maybe_flush()
                         if guard_on and monitor.observe(

@@ -543,6 +543,7 @@ class SingleChipTrainer:
         max_rollbacks: int = 3,
         fault_injector=None,
         checkpoint_keep: int = 2,
+        peak_flops: float | None = None,
     ) -> TrainResult:
         """``metrics``/``metrics_interval``/``metrics_writer``/``tracer``
         are the ISSUE-5 telemetry hooks (``obs``): with a registry the
@@ -629,6 +630,24 @@ class SingleChipTrainer:
         args0 = (jnp.int32(0), jnp.int32(0), self.dropout_key)
         fns: dict[int, Callable] = {}
         compile_time = 0.0
+        # Live resource accounting (ISSUE 10, obs.cost/obs.memory) —
+        # exact analytic CNN FLOPs per step for the train_mfu gauge,
+        # the device peak, a memory watermark sampler, and compile
+        # counters. Host-side arithmetic only: the compiled programs
+        # are untouched, and everything is absent with metrics off.
+        step_flops = peak = mem_sampler = mfu_of = note_compile = None
+        if metrics is not None:
+            from ..obs import cost as _cost
+            from ..obs.memory import MemorySampler, record_compile
+
+            mfu_of = _cost.mfu
+            note_compile = record_compile
+            step_flops = _cost.cnn_train_step_flops(
+                cfg.batch_size, cfg.conv_channels, cfg.fc_sizes
+            )
+            dev0 = jax.devices()[0]
+            peak = _cost.peak_flops_per_device(dev0, peak_flops)
+            mem_sampler = MemorySampler(metrics, [dev0])
 
         def fn_for(k: int):
             # On-demand: a guard rollback can realign spans onto lengths
@@ -638,7 +657,11 @@ class SingleChipTrainer:
                 tc = time.perf_counter()
                 fns[k] = self._chunk_fn(k, health=health_on, guard=guard_on) \
                     .lower(params, opt_state, xs, ys, *args0).compile()
-                compile_time += time.perf_counter() - tc
+                t1 = time.perf_counter()
+                compile_time += t1 - tc
+                if metrics is not None:
+                    note_compile(metrics, tracer, "train_span",
+                                 t0=tc, t1=t1, k=k)
             return fns[k]
 
         resume_epoch, resume_spans = resume_plan(
@@ -653,6 +676,9 @@ class SingleChipTrainer:
         if x_test.shape[0]:
             evaluate(params, x_test, y_test)
         compile_time += time.perf_counter() - t0
+        if metrics is not None and x_test.shape[0]:
+            note_compile(metrics, tracer, "eval",
+                         t0=t0, t1=time.perf_counter())
         resumed_from = start_step
 
         def _rollback():
@@ -719,6 +745,12 @@ class SingleChipTrainer:
                             metrics.gauge("train_images_per_sec").set(
                                 k * cfg.batch_size / span_s if span_s else 0.0
                             )
+                            # MFU (ISSUE 10): analytic FLOPs of the k
+                            # steps just dispatched over the device's
+                            # peak for the measured bracket.
+                            metrics.gauge("train_mfu").set(mfu_of(
+                                step_flops * k, span_s, 1, peak
+                            ))
                             # Tripwire from EVERY span (tiny [k] int32
                             # fetch after the span barrier); full norm
                             # dict only on interval-crossing spans.
@@ -734,6 +766,10 @@ class SingleChipTrainer:
                                 hlt.record_health(metrics,
                                                   jax.device_get(hstack),
                                                   include_nonfinite=False)
+                                # Memory watermarks on the SAME
+                                # interval boundary (obs.memory) —
+                                # host allocator query, no device sync.
+                                mem_sampler.sample()
                             if metrics_writer is not None:
                                 metrics_writer.maybe_flush()
                         if guard_on and monitor.observe(

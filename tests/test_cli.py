@@ -283,6 +283,36 @@ def test_main_serve_paged_pool_end_to_end(capsys):
                for c in payload["completions"].values())
 
 
+def test_main_serve_slo_rules_and_prom_port(capsys):
+    """ISSUE 10 CLI surface: ``--slo-rules`` arms the streaming
+    burn-rate monitor on the single-engine serve path (every TTFT
+    misses the 1ns target, so the rule alerts) and ``--prom-port 0``
+    stands up the /metrics endpoint for the run (ephemeral port,
+    printed). The JSON contract carries the per-rule burn/alert
+    digest; flag hygiene rejects the flag off the serve variant."""
+    assert main([
+        "serve", "--slots", "2", "--capacity", "64", "--max-new-tokens",
+        "4", "--num-prompts", "3", "--prompt-min", "6", "--prompt-max",
+        "12", "--vocab", "16", "--d-model", "32", "--heads", "2",
+        "--layers", "2", "--d-ff", "64", "--prom-port", "0",
+        "--slo-rules",
+        "ttft:metric=serve_ttft_seconds,target=0.000000001,fast=2,slow=4,"
+        "objective=0.5",
+        "--json",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "metrics endpoint: http://127.0.0.1:" in out
+    payload = json.loads(out.strip().splitlines()[-1])
+    row = payload["slo_rules"]["ttft"]
+    assert row["alerts"] >= 1 and row["fired_ticks"]
+    assert row["slow_burn"] > 1.0
+    with pytest.raises(SystemExit, match="--slo-rules does not apply"):
+        main(["lm", "--platform", "cpu", "--slo-rules",
+              "r:metric=m,target=1"])
+    with pytest.raises(SystemExit, match="--slo-rules"):
+        main(["serve", "--platform", "cpu", "--slo-rules", "bogus"])
+
+
 def test_main_serve_router_end_to_end_from_checkpoint(tmp_path, capsys):
     """ISSUE 8 CLI surface: a tiny lm training run leaves a checkpoint;
     ``serve --replicas 2 --traffic ... --slo ...`` serves a mixed

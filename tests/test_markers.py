@@ -115,7 +115,13 @@ def estimate(fn) -> tuple[bool, int, int]:
     for node in ast.walk(fn):
         if id(node) in skip:
             continue
-        if isinstance(node, ast.Name) and node.id in ("Scheduler", "Router"):
+        if isinstance(node, ast.Name) and node.id in (
+            "Scheduler", "Router", "SloMonitor",
+        ):
+            # SloMonitor (ISSUE 10): the SLO tests drive schedulers/
+            # routers through the monitor surface — a monitor name
+            # alone marks the test as scheduler-driving, so the new
+            # SLO/export tests count into the same budgets.
             uses_scheduler = True
         if isinstance(node, ast.For) and isinstance(
             node.iter, (ast.Tuple, ast.List)
@@ -331,6 +337,37 @@ def _audit_faults(tree) -> list[tuple[str, int, int]]:
         if steps > MAX_FAST_TRAIN_STEPS or cycles > MAX_FAST_RESUME_CYCLES:
             out.append((fn.name, steps, cycles))
     return out
+
+
+def test_slo_audit_estimator_extension():
+    """ISSUE 10 self-pin: an ``SloMonitor`` name alone marks a test as
+    scheduler-driving (the SLO tests drive serving through the monitor
+    surface), so token/topology overruns in the new SLO/export tests
+    flag exactly like direct Scheduler/Router tests; a monitor-only
+    test within budget stays exempt-by-budget."""
+    src = textwrap.dedent("""
+        def test_slo_token_overrun():
+            mon = SloMonitor([rule], reg)
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=8)},
+                max_requests=20)
+            drive(mon, t)
+
+        def test_slo_in_budget():
+            mon = SloMonitor([rule], reg)
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=2)},
+                max_requests=10)
+            drive(mon, t)
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_slo_token_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_slo_token_overrun"])
+    assert uses and tokens == 160 and topo == 1
+    uses, tokens, _ = estimate(fns["test_slo_in_budget"])
+    assert uses and tokens == 20
 
 
 def test_fault_injection_tests_carry_slow_marker():

@@ -75,6 +75,41 @@ def test_histogram_percentiles_match_stepstats_from_times():
         StepStats.from_times([])
 
 
+def test_histogram_percentile_raises_named_error_on_missing_series():
+    """ISSUE 10 satellite: a percentile of nothing is a question error
+    — the empty registry AND a wrong/unknown label set both raise the
+    named ``NoSamplesError`` (a ``LookupError``), never silently 0.0;
+    ``stats()`` keeps its zero-filled StepStats contract."""
+    from ddl_tpu.obs import NoSamplesError
+
+    reg = MetricRegistry()
+    h = reg.histogram("lat")
+    with pytest.raises(NoSamplesError, match="no samples"):
+        h.percentile(50)  # empty registry: never observed at all
+    h.observe(0.5, tp=1)
+    with pytest.raises(NoSamplesError, match="lat"):
+        h.percentile(50, tp=2)  # wrong label set
+    with pytest.raises(NoSamplesError):
+        h.percentile(50)  # unlabelled series still never observed
+    assert isinstance(NoSamplesError("x"), LookupError)
+    assert h.percentile(50, tp=1) == 0.5
+    assert reg.histogram("other").stats() == StepStats.from_times([])
+
+
+def test_prometheus_text_escapes_label_values():
+    """ISSUE 10 satellite: backslash, double-quote and newline in a
+    label VALUE are escaped per the Prometheus exposition format — all
+    three characters in one value, pinned byte-for-byte."""
+    reg = MetricRegistry()
+    reg.counter("c").inc(1, path='a\\b"c\nd')
+    text = reg.prometheus_text()
+    assert 'c{path="a\\\\b\\"c\\nd"} 1' in text.splitlines()
+    # The escaped body is the ONLY backslash/newline inside the braces:
+    # the line count is unchanged (a raw newline would split the line).
+    assert sum(1 for line in text.splitlines()
+               if line.startswith("c{")) == 1
+
+
 def test_prometheus_text_and_snapshot():
     reg = MetricRegistry()
     reg.counter("c", "help line").inc(5, tp=1)
@@ -294,6 +329,54 @@ def test_derive_request_slo_group_by_grouped_equals_filtered():
                                  group_by=lambda rid: "x" if rid < 2
                                  else None)
     assert set(partial) == {"x"} and partial["x"][0].steps == 2
+
+
+def test_derive_request_slo_degenerate_inputs():
+    """ISSUE 10 satellite: the documented SKIP semantics on degenerate
+    inputs — empty record list, a group with zero completions (absent,
+    not zero-filled), and a callable group_by returning None — without
+    ever raising (the derivation is a read-only reporting surface)."""
+    from ddl_tpu.serve import derive_request_slo
+    from ddl_tpu.serve.scheduler import request_slo_samples
+
+    # Empty record list: zero-filled StepStats ungrouped, {} grouped,
+    # {} samples.
+    ttft, itl = derive_request_slo([])
+    assert ttft == StepStats.from_times([]) and itl == StepStats.from_times([])
+    assert derive_request_slo([], group_by={}) == {}
+    assert request_slo_samples([]) == {}
+
+    # A synthetic trace: request 0 served (eligible -> first_token ->
+    # one chained decode), request 1 shed (eligible only — no first
+    # token ever).
+    records = [
+        {"type": "event", "name": "eligible", "t": 1.0,
+         "attrs": {"req": 0}},
+        {"type": "event", "name": "eligible", "t": 1.0,
+         "attrs": {"req": 1}},
+        {"type": "event", "name": "shed", "t": 1.5, "attrs": {"req": 1}},
+        {"type": "event", "name": "first_token", "t": 2.0,
+         "attrs": {"req": 0}},
+        {"type": "span", "name": "decode_tick", "t0": 2.0, "t": 2.5,
+         "attrs": {"chained": True, "reqs": [0]}},
+    ]
+    # Group with zero completions: "shed_group" holds only request 1,
+    # which never reached a first token -> the group is ABSENT (skip,
+    # not a zero-filled entry — no latency evidence is not zero
+    # latency; the router's ClassReport counts the miss separately).
+    grouped = derive_request_slo(
+        records, group_by={0: "served", 1: "shed_group"}
+    )
+    assert set(grouped) == {"served"}
+    assert grouped["served"][0].steps == 1
+    assert grouped["served"][0].p50_ms == pytest.approx(1000.0)
+    assert grouped["served"][1].steps == 1  # the one chained gap
+    # Callable group_by returning None drops the request everywhere.
+    assert derive_request_slo(records, group_by=lambda rid: None) == {}
+    only0 = derive_request_slo(
+        records, group_by=lambda rid: "g" if rid == 0 else None
+    )
+    assert set(only0) == {"g"} and only0["g"][0].steps == 1
 
 
 # -- in-graph health vs jax.grad oracle -------------------------------------
