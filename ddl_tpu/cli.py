@@ -193,11 +193,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "device-kind table (TPU v2-v5 bf16 peaks; unknown "
                         "kinds and CPU fall back to a documented nominal "
                         "anchor so CPU runs still produce a number)")
+    p.add_argument("--anomaly-rules", default=None, metavar="SPEC",
+                   help="streaming anomaly detection (ddl_tpu.obs.anomaly) "
+                        "on the deterministic tick clock: ';'-joined "
+                        "SIGNAL[:window=W,min=M,threshold=Z,direction="
+                        "high|low|both,scale=S] segments — rolling "
+                        "median/MAD baselines with edge-triggered "
+                        "anomaly_total{signal=} counters, anomaly_last_tick "
+                        "gauges and 'anomaly' trace events. Signals: serve "
+                        "step_time/itl/mfu/queue_depth/active_slots/"
+                        "occupied_slots/pages_free (paged), router "
+                        "backlog/shed_rate, trainers step_time/mfu. "
+                        "Applies to single/lm/serve")
     p.add_argument("--trace-dir", default=None, metavar="DIR",
                    help="capture a structured trace into DIR: host spans/"
                         "request-lifecycle events as host_trace_p*.jsonl "
                         "(convert to Chrome/Perfetto with 'python -m "
-                        "ddl_tpu.obs.trace in.jsonl out.json') PLUS the "
+                        "ddl_tpu.obs.trace in.jsonl out.json', analyze "
+                        "goodput/critical paths offline with 'python -m "
+                        "ddl_tpu.obs.analyze report') PLUS the "
                         "jax.profiler XLA timeline in the same directory")
     p.add_argument("--json", action="store_true",
                    help="emit a single JSON result line at exit")
@@ -682,10 +696,12 @@ def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
     compilation)."""
     registry = writer = tracer = None
     # A registry exists whenever anything consumes it live: the JSONL
-    # writer, the /metrics pull endpoint, or an SLO monitor (ISSUE 10 —
-    # the latter two work without --metrics-out).
+    # writer, the /metrics pull endpoint, an SLO monitor (ISSUE 10), or
+    # an anomaly detector (ISSUE 11) — all but the first work without
+    # --metrics-out.
     if args.metrics_out or args.prom_port is not None \
-            or getattr(args, "slo_rules", None):
+            or getattr(args, "slo_rules", None) \
+            or getattr(args, "anomaly_rules", None):
         from .obs import MetricRegistry
 
         registry = MetricRegistry()
@@ -733,6 +749,36 @@ def _make_slo_monitor(args, registry, tracer=None):
         return SloMonitor(rules, registry, tracer=tracer)
     except ValueError as e:
         raise SystemExit(f"--slo-rules: {e}")
+
+
+def _make_anomaly(args, registry, tracer=None):
+    """``--anomaly-rules``: build the streaming anomaly detector
+    (obs.anomaly) over the run's registry; None when the flag is
+    off."""
+    if not getattr(args, "anomaly_rules", None):
+        return None
+    from .obs.anomaly import AnomalyDetector, parse_anomaly_rules
+
+    try:
+        rules = parse_anomaly_rules(args.anomaly_rules)
+        return AnomalyDetector(rules, registry, tracer=tracer)
+    except ValueError as e:
+        raise SystemExit(f"--anomaly-rules: {e}")
+
+
+def _anomaly_report(detector):
+    """End-of-run ``--anomaly-rules`` surface, shared by every wired
+    variant: one line per signal, returns the JSON digest (None
+    without a detector)."""
+    if detector is None:
+        return None
+    digest = detector.summary()
+    for signal in sorted(digest):
+        row = digest[signal]
+        ticks = row["fired_ticks"]
+        print(f"anomaly signal {signal}: {row['alerts']} alerts"
+              f"{' at ticks ' + str(ticks) if ticks else ''}")
+    return digest
 
 
 def _slo_report(monitor):
@@ -914,6 +960,7 @@ def _run_lm(args) -> int:
         # keeps its traceback (round-4 advisor).
         raise SystemExit(f"lm config error: {e}")
     registry, writer, tracer = _build_obs(args, config=cfg, mesh=trainer.mesh)
+    detector = _make_anomaly(args, registry, tracer)
     exporter = _start_exporter(args, registry)
     try:
         result = trainer.train(
@@ -932,6 +979,7 @@ def _run_lm(args) -> int:
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=injector,
             peak_flops=args.peak_flops,
+            anomaly_detector=detector,
         )
         if registry is not None:
             registry.gauge("train_final_accuracy").set(result.final_accuracy)
@@ -950,12 +998,14 @@ def _run_lm(args) -> int:
             tracer.close()
         if writer is not None:
             writer.close()
+    anomaly_digest = _anomaly_report(detector)
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.tokens_per_sec:.0f} tokens/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")
     if args.json:
         print(json.dumps({
             "variant": "lm",
+            "anomaly_rules": anomaly_digest,
             "config": {**dataclasses.asdict(cfg),
                        "seq_len": args.seq_len,
                        "train_seqs": args.train_seqs},
@@ -1061,17 +1111,20 @@ def _run_serve_router(args, cfg) -> int:
         # back, in addition to streaming them to the trace file.
         tracer = Tracer(host_trace_file(args.trace_dir), keep=True)
     monitor = _make_slo_monitor(args, registry, tracer)
+    detector = _make_anomaly(args, registry, tracer)
     injector = _make_injector(args, "serve")
     try:
         router = (
             Router.from_checkpoint(rcfg, ckpt, registry=registry,
                                    tracer=tracer, injector=injector,
                                    slo_monitor=monitor,
-                                   peak_flops=args.peak_flops)
+                                   peak_flops=args.peak_flops,
+                                   anomaly_detector=detector)
             if ckpt is not None else
             Router(rcfg, registry=registry, tracer=tracer,
                    injector=injector, slo_monitor=monitor,
-                   peak_flops=args.peak_flops)
+                   peak_flops=args.peak_flops,
+                   anomaly_detector=detector)
         )
     except (ValueError, KeyError) as e:
         raise SystemExit(f"serve config error: {e}")
@@ -1102,6 +1155,7 @@ def _run_serve_router(args, cfg) -> int:
         if writer is not None:
             writer.close()
     slo_digest = _slo_report(monitor)
+    anomaly_digest = _anomaly_report(detector)
     cls_of = {m.id: m.traffic_class for m in traffic}
     summary = rstats.summary()
     for name, row in summary["per_class"].items():
@@ -1124,6 +1178,7 @@ def _run_serve_router(args, cfg) -> int:
             "replicas": args.replicas,
             "router": summary,
             "slo_rules": slo_digest,
+            "anomaly_rules": anomaly_digest,
             "per_class": _class_tallies(done, cls_of),
             "completions": {
                 str(i): {"prompt_len": done[i].prompt_len,
@@ -1233,6 +1288,7 @@ def _run_serve(args) -> int:
         args, config=cfg, mesh=engine.mesh, make_tracer=False
     )
     monitor = _make_slo_monitor(args, registry)
+    detector = _make_anomaly(args, registry)
     injector = _make_injector(args, "serve")
     try:
         scheduler = Scheduler(
@@ -1243,6 +1299,7 @@ def _run_serve(args) -> int:
             injector=injector,
             slo_monitor=monitor,
             peak_flops=args.peak_flops,
+            anomaly_detector=detector,
         )
     except ValueError as e:
         raise SystemExit(f"serve config error: {e}")
@@ -1270,6 +1327,9 @@ def _run_serve(args) -> int:
             if monitor is not None:
                 # slo_alert events land in the run-scoped trace.
                 monitor.tracer = tracer
+            if detector is not None:
+                # anomaly events too — the analyze CLI reads them back.
+                detector.tracer = tracer
             done, stats = scheduler.run(requests)
     finally:
         if exporter is not None:
@@ -1277,6 +1337,20 @@ def _run_serve(args) -> int:
         if writer is not None:
             writer.close()
     slo_digest = _slo_report(monitor)
+    anomaly_digest = _anomaly_report(detector)
+    if registry is not None:
+        gf = registry.get("goodput_fraction")
+        if gf is not None and gf.value() is not None:
+            # The live attribution digest (ISSUE 11): where the run's
+            # observed wall time went, next to the throughput story.
+            tis = registry.get("time_in_seconds")
+            phases = " ".join(
+                f"{ls['phase']}={tis.value(**ls):.2f}s"
+                for ls in sorted(tis.label_sets(),
+                                 key=lambda d: -tis.value(**d))
+                if tis.value(**ls) > 0
+            ) if tis is not None else ""
+            print(f"goodput: {gf.value():.1%} ({phases})")
     for i in sorted(done):
         c = done[i]
         tag = "" if c.status == "ok" else f" [{c.status}]"
@@ -1318,6 +1392,9 @@ def _run_serve(args) -> int:
                 done, {r.id: r.traffic_class for r in requests}
             ),
             "slo_rules": slo_digest,
+            "anomaly_rules": anomaly_digest,
+            "goodput": (scheduler.goodput.summary()
+                        if scheduler.goodput is not None else None),
             "prefill_tokens_per_s": stats.prefill_tokens_per_s,
             "decode_tokens_per_s_per_slot":
                 stats.decode_tokens_per_s_per_slot,
@@ -1371,6 +1448,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.inject_fault and args.variant not in ("single", "lm", "serve"):
         raise SystemExit(
             "--inject-fault applies to the single/lm/serve variants"
+        )
+    if args.anomaly_rules and args.variant not in ("single", "lm", "serve"):
+        # The sync/async span loops predate the per-tick obs feed —
+        # the flag would be silently ignored there (same loud-fail
+        # hygiene as the variant groups).
+        raise SystemExit(
+            "--anomaly-rules applies to the single/lm/serve variants"
         )
     if args.platform:
         import jax
@@ -1482,18 +1566,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     exporter = _start_exporter(args, registry)
     obs_kwargs = {}
+    detector = None
     run_span = contextlib.nullcontext()
     if args.variant == "single":
         # In-graph health + span tracing ride the single-chip trainer
         # (train.trainer); the sync/async strategies report end-of-run
         # summaries into the registry below (their span loops predate
         # the obs layer — README Observability).
+        detector = _make_anomaly(args, registry, tracer)
         obs_kwargs = dict(
             metrics=registry, metrics_interval=args.metrics_interval,
             metrics_writer=writer, tracer=tracer,
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=_make_injector(args, "single"),
             peak_flops=args.peak_flops,
+            anomaly_detector=detector,
         )
     elif tracer is not None:
         # sync/async: the trainers take no tracer, but --trace-dir must
@@ -1549,6 +1636,7 @@ def main(argv: list[str] | None = None) -> int:
             tracer.close()
         if writer is not None:
             writer.close()
+    anomaly_digest = _anomaly_report(detector)
     print(f"training time: {result.train_time_s:.2f}s "
           f"({result.images_per_sec:.0f} images/s, "
           f"compile {result.compile_time_s:.1f}s excluded)")
@@ -1557,6 +1645,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.json:
         print(json.dumps({
             "variant": args.variant,
+            "anomaly_rules": anomaly_digest,
             "config": dataclasses.asdict(cfg),
             "final_accuracy": result.final_accuracy,
             # (epoch, batch/round, accuracy) per eval point — the

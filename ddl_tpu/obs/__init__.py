@@ -39,9 +39,27 @@ The live SLO control plane (ISSUE 10) adds four more:
 - :mod:`ddl_tpu.obs.export` — the stdlib-threaded ``/metrics`` +
   ``/healthz`` HTTP pull endpoint behind CLI ``--prom-port``.
 
+The goodput & time-attribution plane (ISSUE 11) adds three more:
+
+- :mod:`ddl_tpu.obs.goodput` — per-span/per-tick wall-clock phase
+  attribution (``GoodputTracker``): every observed second lands in
+  exactly one phase, published as ``time_in_seconds{phase=}`` +
+  ``goodput_fraction`` gauges next to the MFU story, with the pinned
+  identity that phases sum to the observed wall time.
+- :mod:`ddl_tpu.obs.anomaly` — streaming robust baselines
+  (``AnomalyDetector``): rolling median/MAD per signal on the
+  deterministic tick clock, edge-triggered ``anomaly`` trace events
+  and ``anomaly_total{signal=}`` counters.
+- :mod:`ddl_tpu.obs.analyze` — the offline CLI
+  (``python -m ddl_tpu.obs.analyze``): goodput report, per-request
+  critical-path breakdown and straggler/anomaly tables from a trace
+  JSONL, plus a ``compare`` regression gate over two metrics
+  artifacts (exit nonzero past a threshold).
+
 Everything is surfaced by ``cli.py`` via ``--metrics-out``,
 ``--metrics-interval``, ``--trace-dir``, ``--prom-port``,
-``--peak-flops`` and ``--slo-rules`` (README "Observability").
+``--peak-flops``, ``--slo-rules`` and ``--anomaly-rules``
+(README "Observability").
 """
 
 from .registry import (  # noqa: F401

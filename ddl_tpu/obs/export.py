@@ -1,7 +1,11 @@
 """HTTP pull endpoint for the metric registry (ISSUE 10 tentpole piece
 4): ``GET /metrics`` serves ``MetricRegistry.prometheus_text()`` and
-``GET /healthz`` a liveness JSON, from a stdlib ``ThreadingHTTPServer``
-in a daemon thread — no dependencies, CLI flag ``--prom-port``.
+``GET /healthz`` a liveness JSON — since ISSUE 11 carrying the compact
+goodput digest (current ``goodput_fraction``, last anomaly/SLO-alert
+tick, cumulative anomaly count; see ``obs.goodput.goodput_summary``) so
+a probe sees degradation without parsing the full exposition — from a
+stdlib ``ThreadingHTTPServer`` in a daemon thread — no dependencies,
+CLI flag ``--prom-port``.
 
 The JSONL ``MetricsWriter`` is a push artifact read after the run; the
 pull endpoint is what a live scraper (Prometheus, the PR-11 autoscaler,
@@ -75,9 +79,27 @@ class MetricsExporter:
                                 return
                     self._send(200, body, _CONTENT_TYPE)
                 elif path == "/healthz":
+                    # Compact goodput/degradation digest (ISSUE 11
+                    # satellite): probes see the current goodput
+                    # fraction and the last anomaly/SLO-alert tick
+                    # without scraping /metrics. Read NON-creatingly
+                    # (registry.get) with the same mutation-race
+                    # retry discipline as /metrics.
+                    from .goodput import goodput_summary
+
+                    body = {"status": "ok"}
+                    for attempt in range(_SNAPSHOT_RETRIES):
+                        try:
+                            body.update(goodput_summary(exporter.registry))
+                            break
+                        except RuntimeError:
+                            if attempt == _SNAPSHOT_RETRIES - 1:
+                                body = {"status": "degraded",
+                                        "error": "snapshot raced registry "
+                                                 "mutation"}
                     self._send(
                         200,
-                        json.dumps({"status": "ok"}).encode() + b"\n",
+                        json.dumps(body).encode() + b"\n",
                         "application/json",
                     )
                 else:

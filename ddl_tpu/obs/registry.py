@@ -182,6 +182,13 @@ class MetricRegistry:
     def histogram(self, name: str, help: str = "") -> Histogram:
         return self._get(Histogram, name, help)
 
+    def get(self, name: str) -> _Metric | None:
+        """NON-creating lookup (ISSUE 11): read-only consumers — the
+        ``/healthz`` goodput summary, probes — must never materialize
+        an empty series just by asking (the create-on-first-use
+        accessors above are for writers)."""
+        return self._metrics.get(name)
+
     def metrics(self) -> list[_Metric]:
         return [self._metrics[n] for n in sorted(self._metrics)]
 

@@ -230,6 +230,14 @@ class SloMonitor:
                     "slo_alerts_total",
                     "entries into the alerting state per rule",
                 ).inc(rule=rule.name)
+                # The /healthz goodput summary (ISSUE 11 satellite)
+                # reads this non-creatingly — probes see "when did an
+                # alert last fire" without scraping /metrics.
+                self.registry.gauge(
+                    "slo_last_alert_tick",
+                    "monitor tick of the most recent alert entry per "
+                    "rule",
+                ).set(self.ticks, rule=rule.name)
                 if self.tracer:
                     self.tracer.event(
                         "slo_alert", rule=rule.name, tick=self.ticks,

@@ -208,13 +208,43 @@ def trace_context(trace_dir: str | os.PathLike | None):
 
 # -- Chrome/Perfetto conversion ---------------------------------------------
 
+# Instant events that mark attribution incidents (ISSUE 11 satellite):
+# rendered GLOBALLY scoped (a full-height line on the timeline, not a
+# thread-local tick) under a dedicated category, and chained into flow
+# arrows so the timeline shows WHERE the incident's time went — a
+# guard_skip flows to its guard_rollback, a shed to the request's
+# completion record, consecutive anomalies of one signal to each other.
+INCIDENT_EVENTS = frozenset({
+    "anomaly", "guard_skip", "guard_rollback", "shed", "router_shed",
+    "deadline_exceeded", "slo_alert",
+})
+
+
+def _flow_key(name: str, attrs: dict):
+    """The identity a flow chain follows: the request for lifecycle
+    incidents, the signal for anomalies, the rule for SLO alerts, one
+    shared chain for the trainer guard (its skips flow into the
+    rollback that resolves them)."""
+    if "req" in attrs:
+        return ("req", attrs["req"])
+    if "signal" in attrs:
+        return ("signal", attrs["signal"])
+    if "rule" in attrs:
+        return ("rule", attrs["rule"])
+    if name.startswith("guard_"):
+        return ("guard", "train")
+    return None
+
 
 def chrome_trace_events(records) -> list[dict]:
     """Tracer records -> Chrome ``trace_event`` list (``ph``="X"
     complete events for spans, "i" instants for events; timestamps in
-    microseconds of the monotonic clock). Wrap in
+    microseconds of the monotonic clock). Incident instants
+    (:data:`INCIDENT_EVENTS`) carry ``cat="incident"``, global scope,
+    and flow (``s``/``t``/``f``) chains as above. Wrap in
     ``{"traceEvents": [...]}`` or pass through :func:`convert`."""
     out = []
+    chains: dict[tuple, list[dict]] = {}
     for r in records:
         base = {
             "name": r["name"],
@@ -225,9 +255,38 @@ def chrome_trace_events(records) -> list[dict]:
         if r.get("type") == "span":
             out.append({**base, "ph": "X", "ts": r["t0"] * 1e6,
                         "dur": r["dur_s"] * 1e6})
-        else:
-            out.append({**base, "ph": "i", "ts": r["t"] * 1e6, "s": "t"})
-    return sorted(out, key=lambda e: (e["ts"], e["name"]))
+            continue
+        inst = {**base, "ph": "i", "ts": r["t"] * 1e6, "s": "t"}
+        attrs = r.get("attrs", {})
+        name = r["name"]
+        incident = name in INCIDENT_EVENTS
+        if incident:
+            inst["s"] = "g"
+            inst["cat"] = "incident"
+        out.append(inst)
+        # Flow chains: every incident joins its key's chain; a
+        # request's `complete` instant terminates that request's chain
+        # (so shed/deadline incidents point at the completion record)
+        # without itself opening one.
+        key = _flow_key(name, attrs)
+        if key is not None and (incident or (name == "complete"
+                                             and key in chains)):
+            chains.setdefault(key, []).append(inst)
+    for flow_id, key in enumerate(sorted(chains, key=str), start=1):
+        chain = chains[key]
+        if len(chain) < 2:
+            continue
+        for i, inst in enumerate(chain):
+            ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+            flow = {
+                "name": f"incident:{key[0]}={key[1]}",
+                "cat": "incident_flow", "ph": ph, "id": flow_id,
+                "ts": inst["ts"], "pid": inst["pid"], "tid": inst["tid"],
+            }
+            if ph == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice's end
+            out.append(flow)
+    return sorted(out, key=lambda e: (e["ts"], e["name"], e["ph"]))
 
 
 def read_jsonl(path) -> list[dict]:

@@ -217,7 +217,8 @@ class Router:
 
     def __init__(self, config: RouterConfig, params=None, *,
                  registry=None, tracer=None, injector=None,
-                 slo_monitor=None, peak_flops: float | None = None):
+                 slo_monitor=None, peak_flops: float | None = None,
+                 anomaly_detector=None):
         if config.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1, got {config.replicas}"
@@ -252,6 +253,19 @@ class Router:
                     "slo_monitor was built on a different registry than "
                     "this router's — it would read counters the router "
                     "never writes (burn 0.0 forever). Build it on the "
+                    "registry passed as registry="
+                )
+        if anomaly_detector is not None:
+            if registry is None:
+                raise ValueError(
+                    "anomaly_detector needs the router registry it emits "
+                    "anomaly_* metrics into; pass registry= as well"
+                )
+            if anomaly_detector.registry is not registry:
+                raise ValueError(
+                    "anomaly_detector was built on a different registry "
+                    "than this router's — its anomaly_* metrics would "
+                    "land where nothing reads them. Build it on the "
                     "registry passed as registry="
                 )
         self.config = config
@@ -304,6 +318,17 @@ class Router:
         # per-replica schedulers keep slo_monitor=None: one clock, one
         # evaluator.
         self.slo_monitor = slo_monitor
+        # Anomaly detection (ISSUE 11): scored once per GLOBAL tick in
+        # run() over the router's fleet-level signal vocabulary —
+        # `backlog` (occupied + pending summed over replicas) and
+        # `shed_rate` (router sheds this tick). Both are deterministic
+        # functions of the global tick clock (placement reads only
+        # deterministic host state), so the seeded bulk-burst scenario
+        # fires its anomaly at identical ticks across fresh runs
+        # (pinned in tests/test_goodput.py). Like the monitor, one
+        # clock, one evaluator — replica schedulers keep their own
+        # detectors off.
+        self.anomaly = anomaly_detector
         self._sticky: dict[bytes, int] = {}
 
     @classmethod
@@ -495,6 +520,7 @@ class Router:
         # registries, invisible to the router's monitor).
         scanned = rec_start
         eligible_t: dict[int, float] = {}
+        shed_prev = 0
         try:
             while i < len(reqs) or any(not s.idle for s in self.scheds):
                 while i < len(reqs) and reqs[i].arrival <= t:
@@ -523,12 +549,24 @@ class Router:
                                     **{"class": cls_of[rid]},
                                 )
                     scanned = len(recs)
+                    total_backlog = 0
                     for k, sched in enumerate(self.scheds):
                         p = sched.pressure()
+                        outstanding = p.occupied_slots + p.pending_total
+                        total_backlog += outstanding
                         self.registry.gauge(
                             "router_replica_outstanding"
-                        ).set(p.occupied_slots + p.pending_total,
-                              replica=k)
+                        ).set(outstanding, replica=k)
+                    if self.anomaly is not None:
+                        # Fleet-level signals on the global tick clock
+                        # (ctor comment): both deterministic, so the
+                        # burst scenario's firing tick replays exactly.
+                        sheds_now = counters["router_sheds"]
+                        self.anomaly.tick({
+                            "backlog": total_backlog,
+                            "shed_rate": sheds_now - shed_prev,
+                        })
+                        shed_prev = sheds_now
                 if self.slo_monitor is not None:
                     # One burn-rate window step per GLOBAL tick — the
                     # same deterministic clock routing decisions use,

@@ -92,6 +92,19 @@ traffic must not pollute a run's telemetry). Both default off, and
 every clock read they add is gated on the tracer/registry being
 present — a bare ``Scheduler(engine)`` runs the exact
 pre-observability tick loop.
+
+Time attribution (ISSUE 11): with a registry, every tick's wall time
+decomposes into the ``obs.goodput`` serve phases — prefill / decode /
+prefix_copy (the existing StepTimer brackets, attributed as they
+close), shed (the shed/deadline sweep), and the tick residual as host
+(device work happened) or idle (it did not) — published live as
+``time_in_seconds{phase=}`` / ``goodput_fraction`` gauges with the
+pinned identity that phases sum to observed tick time. An optional
+``anomaly_detector`` (``obs.anomaly``) is scored once per tick over
+step_time / itl / mfu / queue_depth / active_slots / occupied_slots /
+pages_free; the host-state signals are deterministic functions of the
+tick clock, which is what pins the stall-injection scenario's anomaly
+to identical ticks across runs (tests/test_goodput.py).
 """
 
 from __future__ import annotations
@@ -103,6 +116,7 @@ import time
 import numpy as np
 
 from ..obs import cost as _cost
+from ..obs.goodput import GoodputTracker
 from ..obs.memory import MemorySampler, record_compile
 from ..obs.trace import NULL_TRACER
 from ..utils.metrics import StepStats, StepTimer
@@ -285,7 +299,8 @@ class Scheduler:
                  metrics_writer=None, ttft_deadline_s: float | None = None,
                  deadline_s: float | None = None,
                  shed_threshold: int | None = None, injector=None,
-                 slo_monitor=None, peak_flops: float | None = None):
+                 slo_monitor=None, peak_flops: float | None = None,
+                 anomaly_detector=None):
         self.engine = engine
         self.eos_id = eos_id
         if allow_window and engine.paged:
@@ -345,11 +360,32 @@ class Scheduler:
                 "writes (burn 0.0 forever). Build it on the registry "
                 "passed as registry="
             )
+        # Anomaly detection (ISSUE 11): an obs.anomaly.AnomalyDetector
+        # scored once per tick with the tick's signal vocabulary —
+        # step_time / itl / mfu (wall-clock) and queue_depth /
+        # active_slots / occupied_slots / pages_free (deterministic
+        # host state, the signals the pinned scenarios use).
+        self.anomaly = anomaly_detector
+        if anomaly_detector is not None \
+                and anomaly_detector.registry is not registry:
+            raise ValueError(
+                "anomaly_detector was built on a different registry than "
+                "this scheduler's — its anomaly_* metrics would land "
+                "where nothing reads them. Build it on the registry "
+                "passed as registry="
+            )
         self._peak_flops = peak_flops
         self._peak: float | None = None
         self._mem = None
+        # Goodput attribution (ISSUE 11): every tick's wall time lands
+        # in exactly one phase (obs.goodput — prefill/decode/
+        # prefix_copy/shed/idle/host), published live next to
+        # serve_mfu. A ctor feature like the memory sampler: no
+        # registry -> no tracker, no extra clock reads.
+        self._goodput = None
         if registry is not None:
             self._mem = MemorySampler(registry, engine.mesh.devices.flat)
+            self._goodput = GoodputTracker(registry, "serve")
 
             def _on_build(kind, key, _sched=self):
                 # Registry captured directly (compile activity during
@@ -362,6 +398,40 @@ class Scheduler:
         # advanced by tick(), finalized by collect()/release(). run()
         # is sugar over the same four primitives.
         self._st: _RunState | None = None
+
+    @property
+    def goodput(self):
+        """The live :class:`obs.goodput.GoodputTracker` (None without a
+        registry) — the attribution read surface (ISSUE 11)."""
+        return self._goodput
+
+    def attach_registry(self, registry) -> None:
+        """Swap the live metric registry mid-lifetime (the bench's
+        per-repetition isolation, ISSUE 11): rebuilds the ctor-time
+        consumers that capture it — the goodput tracker and memory
+        sampler — so a post-hoc attach gets the same gauges a
+        ctor-time registry does. The engine compile hook keeps its
+        ctor registry (compile activity belongs to the build that
+        compiled, not to whichever rep runs next). A bound SLO
+        monitor/anomaly detector pins the registry: swapping under
+        them would strand their metrics (or unbind `depth` for the
+        anomaly feed) — the same invariant the ctor enforces, so the
+        swap is rejected loudly here too."""
+        for name, consumer in (("slo_monitor", self.slo_monitor),
+                               ("anomaly_detector", self.anomaly)):
+            if consumer is not None and consumer.registry is not registry:
+                raise ValueError(
+                    f"attach_registry would strand the bound {name} on "
+                    "its old registry (the ctor-enforced same-registry "
+                    "invariant); rebuild it on the new registry first "
+                    "or detach it"
+                )
+        self.registry = registry
+        self._mem = self._goodput = None
+        if registry is not None:
+            self._mem = MemorySampler(registry,
+                                      self.engine.mesh.devices.flat)
+            self._goodput = GoodputTracker(registry, "serve")
 
     def warmup(self, requests) -> None:
         """Compile the decode program and every prefill bucket / prefix
@@ -390,16 +460,19 @@ class Scheduler:
         saved = (self.tracer, self.registry, self.metrics_writer,
                  self.ttft_deadline_s, self.deadline_s,
                  self.shed_threshold, self.injector, self.slo_monitor,
-                 self._mem)
+                 self._mem, self.anomaly, self._goodput)
         self.tracer, self.registry, self.metrics_writer = \
             NULL_TRACER, None, None
         self.ttft_deadline_s = self.deadline_s = None
         self.shed_threshold = self.injector = None
-        # The SLO monitor and memory sampler are per-TICK consumers:
-        # warmup's clone ticks must not advance burn-rate windows or
-        # sample watermarks mid-compile (the engine compile hook stays
-        # live — compile activity during warmup IS its signal).
+        # The SLO monitor, memory sampler, anomaly detector and goodput
+        # tracker are per-TICK consumers: warmup's clone ticks must not
+        # advance burn-rate/baseline windows, sample watermarks or
+        # attribute compile-warm time mid-compile (the engine compile
+        # hook stays live — compile activity during warmup IS its
+        # signal).
         self.slo_monitor = self._mem = None
+        self.anomaly = self._goodput = None
         try:
             self.run([
                 dataclasses.replace(
@@ -494,7 +567,7 @@ class Scheduler:
             (self.tracer, self.registry, self.metrics_writer,
              self.ttft_deadline_s, self.deadline_s,
              self.shed_threshold, self.injector, self.slo_monitor,
-             self._mem) = saved
+             self._mem, self.anomaly, self._goodput) = saved
 
     def _validate(self, r: Request) -> None:
         """Reject a malformed request at SUBMIT time — ``run`` validates
@@ -828,6 +901,14 @@ class Scheduler:
         tr = self.tracer
         reg = self.registry
         inj = self.injector
+        # Goodput attribution (ISSUE 11): the whole tick is bracketed;
+        # device sub-brackets (prefill/decode/prefix-copy — the SAME
+        # StepTimer values the histograms observe) are attributed as
+        # they close and the residual lands in host/idle at end_tick.
+        gp = self._goodput
+        if gp is not None:
+            gp.begin_tick()
+        decode_s = itl_s = mfu_val = None
         chunk = cfg.prefill_chunk
         # Unset budget defaults to ONE chunk per tick — maximum decode
         # interleaving; chunking with an unmetered tick would run every
@@ -865,6 +946,13 @@ class Scheduler:
                     # Stamped with the SAME `now` the TTFT clock
                     # starts from — the derived-TTFT exactness pin.
                     tr.event("eligible", t=now, req=int(r.id), step=step)
+        # The shed/deadline sweep is attributed as "shed" overhead
+        # (work=False: bookkeeping, not device work) — only bracketed
+        # when it can actually do something, so the common fast path
+        # pays no clock reads.
+        t_shed0 = (time.perf_counter()
+                   if gp is not None and (shed_now or st.deadlines_on)
+                   else None)
         for r in shed_now:
             self._expire_queued(st, r, "shed")
         if st.deadlines_on:
@@ -894,6 +982,8 @@ class Scheduler:
                                     else (total,)) if v is not None]
                 if lims and now - st.eligible_wall[r.id] > min(lims):
                     self._finish(st, s, status="deadline_exceeded")
+        if t_shed0 is not None:
+            gp.add("shed", time.perf_counter() - t_shed0, work=False)
         # Admit: claim every free slot whose turn has come. With the
         # prefix cache, admission itself is only the (optional) row
         # copy (contiguous) or table mapping (paged) — prompt
@@ -952,8 +1042,12 @@ class Scheduler:
             if eng.prefix is not None:
                 st.lookups += 1
                 if hit >= MIN_PREFIX_HIT:
-                    t0 = time.perf_counter() if tr else 0.0
+                    timed = tr or gp is not None
+                    t0 = time.perf_counter() if timed else 0.0
                     copied = eng.prefix_fetch(entry, hit, s)
+                    t1 = time.perf_counter() if timed else 0.0
+                    if gp is not None:
+                        gp.add("prefix_copy", t1 - t0)
                     if tr:
                         # Contiguous: a pool->slot row gather of all
                         # `hit` rows. Paged: zero-copy page mapping;
@@ -963,7 +1057,7 @@ class Scheduler:
                         tr.complete(
                             "prefix_map" if eng.paged
                             else "prefix_copy",
-                            t0, time.perf_counter(),
+                            t0, t1,
                             req=int(r.id), slot=s, rows=hit,
                             copied_rows=int(copied),
                         )
@@ -1021,6 +1115,11 @@ class Scheduler:
                     tr.complete("prefill_chunk", t0,
                                 time.perf_counter(),
                                 req=int(r.id), slot=s, base=base, n=n)
+                if gp is not None:
+                    # The SAME bracket the StepTimer recorded — the
+                    # attribution and the latency surface cannot
+                    # disagree.
+                    gp.add("prefill", st.prefill_timer._times[-1])
                 if reg is not None:
                     reg.counter("serve_prefill_tokens_total").inc(n)
                     # The SAME bracket value the StepTimer recorded,
@@ -1080,7 +1179,11 @@ class Scheduler:
                 # The gap since the previous decode completion —
                 # prefill work interleaved between ticks included.
                 st.itls.append(now - st.last_decode_done)
+                itl_s = st.itls[-1]
             st.last_decode_done = now
+            decode_s = st.decode_timer._times[-1]
+            if gp is not None:
+                gp.add("decode", decode_s)
             if tr:
                 # End timestamp == the ITL clock's `now`; `chained`
                 # records whether the gap-to-previous counted, so
@@ -1108,10 +1211,11 @@ class Scheduler:
                     cfg.spec, eng.last_attend_width
                 )
                 reg.gauge("serve_flops_per_token").set(fpt)
-                reg.gauge("serve_mfu").set(_cost.mfu(
+                mfu_val = _cost.mfu(
                     fpt * n_active, st.decode_timer._times[-1],
                     int(eng.mesh.devices.size), self._resolve_peak(),
-                ))
+                )
+                reg.gauge("serve_mfu").set(mfu_val)
             for s in range(S):
                 if not st.active[s]:
                     continue
@@ -1159,8 +1263,10 @@ class Scheduler:
             # Device memory watermarks (obs.memory, ISSUE 10): a host
             # allocator query, self-latching off on backends without
             # memory_stats — one attribute check per tick after that.
-            # None when the registry was attached POST-ctor (the bench
-            # per-rep registry swap) — watermarks are a ctor feature.
+            # Present from the ctor OR a later attach_registry (the
+            # bench per-rep swap rebuilds it, ISSUE 11); None only
+            # when the registry was installed by a bare attribute
+            # write.
             if self._mem is not None:
                 self._mem.sample()
             if self.metrics_writer is not None:
@@ -1168,11 +1274,39 @@ class Scheduler:
                 # gauge HISTORY lands in the JSONL as a time series,
                 # not just the final tick's values.
                 self.metrics_writer.maybe_flush()
+        if self.anomaly is not None:
+            # Score this tick's signal vocabulary (obs.anomaly). The
+            # detector's registry is validated == self.registry at the
+            # ctor, so `depth` above is always bound here. Host-state
+            # signals (queue_depth/active_slots/occupied_slots/
+            # pages_free) are deterministic functions of the tick
+            # clock — the pinned scenarios fire on them; the wall-clock
+            # signals (step_time/itl/mfu) ride along for live ops.
+            vals: dict = {
+                "queue_depth": depth,
+                "active_slots": int(st.active.sum()),
+                "occupied_slots": sum(o is not None for o in st.occupant),
+            }
+            if eng.paged:
+                vals["pages_free"] = int(eng.pages.free)
+            if decode_s is not None:
+                vals["step_time"] = decode_s
+                if mfu_val is not None:
+                    vals["mfu"] = mfu_val
+            if itl_s is not None:
+                vals["itl"] = itl_s
+            self.anomaly.tick(vals)
         if self.slo_monitor is not None:
             # Advance the burn-rate windows one tick (obs.slo): reads
             # only its own registry, so runs without a monitor are
             # untouched.
             self.slo_monitor.tick()
+        if gp is not None:
+            # Close the tick bracket: residual time (admission,
+            # telemetry, the deadline-wait sleep) files under host or
+            # idle and the gauges publish — the identity holds every
+            # tick.
+            gp.end_tick()
         st.step = step + 1
         if all(o is None for o in st.occupant) and st.pending:
             # Idle gap before the next arrival: every intervening

@@ -1239,6 +1239,7 @@ class SeqTrainer:
         fault_injector=None,
         checkpoint_keep: int = 2,
         peak_flops: float | None = None,
+        anomaly_detector=None,
     ) -> LMResult:
         """Same persistence/observability contract as every other trainer:
         atomic rolling checkpoint at epoch ends (plus every
@@ -1269,7 +1270,19 @@ class SeqTrainer:
         checkpoint and replay from its step — the data stream is
         indexed by global step, so position IS the re-seed.
         ``fault_injector`` (``resilience.faults``) is the deterministic
-        chaos hook the tests and ``--inject-fault`` drive."""
+        chaos hook the tests and ``--inject-fault`` drive.
+
+        Time attribution (ISSUE 11): with ``metrics`` on, every
+        bracket the loop already closes is attributed to one
+        ``obs.goodput`` train phase — compute (the span dispatch, with
+        a guarded span's skipped-step share re-filed as stall),
+        staging, compile, eval, checkpoint_io, and rollback stall —
+        published live as ``time_in_seconds{phase=}`` /
+        ``goodput_fraction`` gauges next to ``train_mfu``; the pinned
+        identity is that the phases sum to the observed bracket time.
+        ``anomaly_detector`` (``obs.anomaly``, same registry as
+        ``metrics``) is scored once per span over ``step_time``
+        (span seconds per step) and ``mfu``."""
         cfg = self.config
         if tracer is None:
             tracer = NULL_TRACER
@@ -1298,6 +1311,22 @@ class SeqTrainer:
                 w = inj.poison_batches(np.asarray(w), batch_num, bs)
             return self.stage_batches(w, batch_num, bs)
 
+        # Goodput attribution (ISSUE 11, obs.goodput): host arithmetic
+        # on brackets the loop already closes — absent entirely with
+        # metrics off, so the off path gains no clock reads.
+        gp = None
+        if metrics is not None:
+            from ..obs.goodput import GoodputTracker
+
+            gp = GoodputTracker(metrics, "train")
+        if anomaly_detector is not None and (
+                metrics is None or anomaly_detector.registry is not metrics):
+            raise ValueError(
+                "anomaly_detector must be built on the registry passed "
+                "as metrics= (its anomaly_* metrics would otherwise land "
+                "where nothing reads them)"
+            )
+        t_stage0 = time.perf_counter() if gp is not None else 0.0
         xs = self.stage_batches(ds.tokens, batch_num, bs)
         ys = self.stage_batches(ds.targets, batch_num, bs)
         ws = _stage_ws()
@@ -1327,6 +1356,10 @@ class SeqTrainer:
             ),
             dispatch_timeout, "train-set staging",
         )
+        if gp is not None:
+            # The whole host->device upload: stage_batches' lazy puts
+            # materialize at the force barrier just closed.
+            gp.add("staging", time.perf_counter() - t_stage0)
 
         spans = eval_spans(batch_num, cfg.eval_every)
         resume_epoch, resume_spans = resume_plan(
@@ -1376,6 +1409,7 @@ class SeqTrainer:
                     # mid-run latency incident — now auditable.
                     record_compile(metrics, tracer, "train_span",
                                    t0=tc, t1=t1, k=k)
+                    gp.add("compile", t1 - tc)
             return fns[k]
 
         t0 = time.perf_counter()
@@ -1385,8 +1419,9 @@ class SeqTrainer:
         ev = self._eval_fn().lower(params, xte, yte, wte).compile()
         compile_time = time.perf_counter() - t0
         if metrics is not None:
-            record_compile(metrics, tracer, "eval",
-                           t0=te0, t1=time.perf_counter())
+            te1 = time.perf_counter()
+            record_compile(metrics, tracer, "eval", t0=te0, t1=te1)
+            gp.add("compile", te1 - te0)
 
         def _rollback():
             """Guard escalation: restore the newest VALID checkpoint at
@@ -1430,6 +1465,7 @@ class SeqTrainer:
                         if gstep < start_step:
                             continue  # already done by the resumed run
                         span_idx += 1
+                        compile_before = compile_time
                         with timer.step(images=k * tokens_per_batch), \
                                 tracer.span("train/span", gstep=gstep, k=k):
                             out = fn_for(k)(
@@ -1444,6 +1480,12 @@ class SeqTrainer:
                                 lambda: float(l), dispatch_timeout,
                                 f"span dispatch at global batch {gstep}",
                             )
+                        # One host fetch of the [k] skip flags, shared
+                        # by the goodput stall split and the guard
+                        # monitor (the span barrier already executed —
+                        # no new sync).
+                        skipped_host = (jax.device_get(skipped)
+                                        if guard_on else None)
                         if metrics is not None:
                             span_s = timer._times[-1]  # the bracket just closed
                             metrics.gauge("train_loss").set(loss)
@@ -1458,9 +1500,27 @@ class SeqTrainer:
                             # MFU (ISSUE 10): analytic FLOPs of the k
                             # steps just dispatched over what the mesh
                             # could do at peak in the measured bracket.
-                            metrics.gauge("train_mfu").set(mfu_of(
-                                step_flops * k, span_s, n_dev, peak
-                            ))
+                            mfu_val = mfu_of(step_flops * k, span_s,
+                                             n_dev, peak)
+                            metrics.gauge("train_mfu").set(mfu_val)
+                            # Attribution (ISSUE 11): compile carve-
+                            # out + compute/stall split, shared with
+                            # the single-chip trainer in ONE helper so
+                            # the pinned identities cannot drift.
+                            from ..obs.goodput import \
+                                attribute_train_span
+
+                            attribute_train_span(
+                                gp, span_s,
+                                compile_time - compile_before,
+                                int(np.sum(skipped_host))
+                                if guard_on else 0, k,
+                            )
+                            if anomaly_detector is not None:
+                                anomaly_detector.tick({
+                                    "step_time": span_s / k,
+                                    "mfu": mfu_val,
+                                })
                             # The divergence tripwire reads EVERY span (a
                             # [k] int32 fetch riding the loss barrier — the
                             # span already executed, this adds no sync); the
@@ -1490,19 +1550,31 @@ class SeqTrainer:
                             if metrics_writer is not None:
                                 metrics_writer.maybe_flush()
                         if guard_on and monitor.observe(
-                            jax.device_get(skipped), gstep
+                            skipped_host, gstep
                         ):
+                            t_rb0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             start_step = _rollback()
                             monitor.rolled_back(start_step)
+                            if gp is not None:
+                                # Restore + restage + replay re-entry:
+                                # the fault-tolerance tax.
+                                gp.add("stall",
+                                       time.perf_counter() - t_rb0)
                             rolled = True
                             break
                         if eval_after:
+                            t_ev0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             with tracer.span("train/eval", gstep=gstep + k):
                                 accuracy = guarded(
                                     lambda: float(ev(params, xte, yte, wte)),
                                     dispatch_timeout,
                                     f"eval after batch {first + k - 1}",
                                 )
+                            if gp is not None:
+                                gp.add("eval",
+                                       time.perf_counter() - t_ev0)
                             if metrics is not None:
                                 metrics.gauge("train_eval_accuracy").set(accuracy)
                             history.append((epoch, first + k - 1, accuracy))
@@ -1522,6 +1594,8 @@ class SeqTrainer:
                             gstep, k, checkpoint_every,
                             first + k == batch_num or hit or preempted,
                         ):
+                            t_ck0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             save_checkpoint(
                                 ckpt,
                                 {"params": self._params_for_save(params),
@@ -1529,6 +1603,9 @@ class SeqTrainer:
                                 step=gstep + k, extra={"epoch": epoch},
                                 keep=checkpoint_keep,
                             )
+                            if gp is not None:
+                                gp.add("checkpoint_io",
+                                       time.perf_counter() - t_ck0)
                         if hit or preempted:
                             break
                     if hit:
@@ -1540,15 +1617,22 @@ class SeqTrainer:
         wall = time.perf_counter() - start
 
         if not (history and history[-1][:2] == (epoch, batch_num - 1)) and not hit:
+            t_ev0 = time.perf_counter() if gp is not None else 0.0
             accuracy = guarded(
                 lambda: float(ev(params, xte, yte, wte)),
                 dispatch_timeout, "final eval",
             )
+            if gp is not None:
+                gp.add("eval", time.perf_counter() - t_ev0)
             if not preempted:
                 # A preempted run's history must not claim an eval point
                 # after batches that never trained; final_accuracy still
                 # reports the stopped state.
                 history.append((epoch, batch_num - 1, accuracy))
+        if gp is not None:
+            # Final publish: the tail brackets (last eval/checkpoint)
+            # land in the gauges even when no span follows them.
+            gp.publish()
         stats = timer.stats()
         log(
             f"final test_accuracy {accuracy:.4f} loss {loss:.4f} "

@@ -544,6 +544,7 @@ class SingleChipTrainer:
         fault_injector=None,
         checkpoint_keep: int = 2,
         peak_flops: float | None = None,
+        anomaly_detector=None,
     ) -> TrainResult:
         """``metrics``/``metrics_interval``/``metrics_writer``/``tracer``
         are the ISSUE-5 telemetry hooks (``obs``): with a registry the
@@ -561,7 +562,18 @@ class SingleChipTrainer:
         the last good checkpoint (requires a checkpoint dir) and replay
         from there (the data stream is re-seeded by step position),
         bounded by ``max_rollbacks``. ``fault_injector`` is the
-        deterministic chaos hook (``resilience.faults``)."""
+        deterministic chaos hook (``resilience.faults``).
+
+        Time attribution (ISSUE 11): with ``metrics`` on, every
+        bracket the loop already closes lands in one ``obs.goodput``
+        train phase (compute / staging / compile / eval /
+        checkpoint_io / stall — a guarded span's skipped-step share
+        and rollback restores are the stall), published live as
+        ``time_in_seconds{phase=}`` / ``goodput_fraction`` gauges;
+        phases sum to the observed bracket time (the pinned identity).
+        ``anomaly_detector`` (``obs.anomaly``, same registry as
+        ``metrics``) is scored once per span over ``step_time`` and
+        ``mfu``."""
         cfg = self.config
         if tracer is None:
             from ..obs.trace import NULL_TRACER
@@ -577,6 +589,21 @@ class SingleChipTrainer:
             monitor = GuardMonitor(max_bad_steps,
                                    max_rollbacks=max_rollbacks,
                                    registry=metrics, tracer=tracer)
+        # Goodput attribution (ISSUE 11, obs.goodput): host arithmetic
+        # on brackets the loop already closes — absent entirely with
+        # metrics off, so the off path gains no clock reads.
+        gp = None
+        if metrics is not None:
+            from ..obs.goodput import GoodputTracker
+
+            gp = GoodputTracker(metrics, "train")
+        if anomaly_detector is not None and (
+                metrics is None or anomaly_detector.registry is not metrics):
+            raise ValueError(
+                "anomaly_detector must be built on the registry passed "
+                "as metrics= (its anomaly_* metrics would otherwise land "
+                "where nothing reads them)"
+            )
         batch_num = self.dataset.num_train // cfg.batch_size
         n = batch_num * cfg.batch_size
         # Sequential batching, no shuffle — reference semantics
@@ -598,6 +625,7 @@ class SingleChipTrainer:
                 dtype=staging_dtype(cfg),
             )
 
+        t_stage0 = time.perf_counter() if gp is not None else 0.0
         xs = _stage_xs()
         ys = jnp.asarray(
             self.y_train_onehot[:n].reshape(
@@ -622,6 +650,10 @@ class SingleChipTrainer:
         # must not absorb the host->HBM upload of the train set.
         guarded(lambda: force((xs, ys, params, opt_state), all_leaves=True),
                 dispatch_timeout, "train-set staging")
+        if gp is not None:
+            # The whole host->device upload: the lazy puts materialize
+            # at the force barrier just closed.
+            gp.add("staging", time.perf_counter() - t_stage0)
         history: list[tuple[int, int, float]] = []
         spans = eval_spans(batch_num, cfg.eval_every)
         # AOT-compile every span program outside the timed region (first TPU
@@ -662,6 +694,7 @@ class SingleChipTrainer:
                 if metrics is not None:
                     note_compile(metrics, tracer, "train_span",
                                  t0=tc, t1=t1, k=k)
+                    gp.add("compile", t1 - tc)
             return fns[k]
 
         resume_epoch, resume_spans = resume_plan(
@@ -677,8 +710,9 @@ class SingleChipTrainer:
             evaluate(params, x_test, y_test)
         compile_time += time.perf_counter() - t0
         if metrics is not None and x_test.shape[0]:
-            note_compile(metrics, tracer, "eval",
-                         t0=t0, t1=time.perf_counter())
+            t1 = time.perf_counter()
+            note_compile(metrics, tracer, "eval", t0=t0, t1=t1)
+            gp.add("compile", t1 - t0)
         resumed_from = start_step
 
         def _rollback():
@@ -718,6 +752,7 @@ class SingleChipTrainer:
                         if gstep < start_step:
                             continue  # already done by the resumed run
                         span_idx += 1
+                        compile_before = compile_time
                         with timer.step(images=k * cfg.batch_size), \
                                 tracer.span("train/span", gstep=gstep, k=k):
                             out = fn_for(k)(
@@ -733,6 +768,12 @@ class SingleChipTrainer:
                                 params, dispatch_timeout,
                                 f"span dispatch at global step {gstep}",
                             )
+                        # One host fetch of the [k] skip flags, shared
+                        # by the goodput stall split and the guard
+                        # monitor (the span barrier already executed —
+                        # no new sync).
+                        skipped_host = (jax.device_get(skipped)
+                                        if guard_on else None)
                         if metrics is not None:
                             from ..obs import health as hlt
 
@@ -748,9 +789,27 @@ class SingleChipTrainer:
                             # MFU (ISSUE 10): analytic FLOPs of the k
                             # steps just dispatched over the device's
                             # peak for the measured bracket.
-                            metrics.gauge("train_mfu").set(mfu_of(
-                                step_flops * k, span_s, 1, peak
-                            ))
+                            mfu_val = mfu_of(step_flops * k, span_s, 1,
+                                             peak)
+                            metrics.gauge("train_mfu").set(mfu_val)
+                            # Attribution (ISSUE 11): compile carve-
+                            # out + compute/stall split, shared with
+                            # the seq trainer in ONE helper so the
+                            # pinned identities cannot drift.
+                            from ..obs.goodput import \
+                                attribute_train_span
+
+                            attribute_train_span(
+                                gp, span_s,
+                                compile_time - compile_before,
+                                int(np.sum(skipped_host))
+                                if guard_on else 0, k,
+                            )
+                            if anomaly_detector is not None:
+                                anomaly_detector.tick({
+                                    "step_time": span_s / k,
+                                    "mfu": mfu_val,
+                                })
                             # Tripwire from EVERY span (tiny [k] int32
                             # fetch after the span barrier); full norm
                             # dict only on interval-crossing spans.
@@ -773,20 +832,32 @@ class SingleChipTrainer:
                             if metrics_writer is not None:
                                 metrics_writer.maybe_flush()
                         if guard_on and monitor.observe(
-                            jax.device_get(skipped), gstep
+                            skipped_host, gstep
                         ):
+                            t_rb0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             start_step = _rollback()
                             monitor.rolled_back(start_step)
+                            if gp is not None:
+                                # Restore + restage + replay re-entry:
+                                # the fault-tolerance tax.
+                                gp.add("stall",
+                                       time.perf_counter() - t_rb0)
                             rolled = True
                             break
                         if eval_after:
                             cnt = first + k - 1
+                            t_ev0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             with tracer.span("train/eval", gstep=gstep + k):
                                 acc = guarded(
                                     lambda: evaluate(params, x_test, y_test),
                                     dispatch_timeout,
                                     f"eval after batch {cnt}",
                                 )
+                            if gp is not None:
+                                gp.add("eval",
+                                       time.perf_counter() - t_ev0)
                             if metrics is not None:
                                 metrics.gauge("train_eval_accuracy").set(acc)
                             history.append((epoch, cnt, acc))
@@ -801,11 +872,16 @@ class SingleChipTrainer:
                             gstep, k, checkpoint_every,
                             first + k == batch_num or stopped or preempted,
                         ):
+                            t_ck0 = (time.perf_counter()
+                                     if gp is not None else 0.0)
                             save_checkpoint(
                                 ckpt, {"params": params, "opt": opt_state},
                                 step=gstep + k, extra={"epoch": epoch},
                                 keep=checkpoint_keep,
                             )
+                            if gp is not None:
+                                gp.add("checkpoint_io",
+                                       time.perf_counter() - t_ck0)
                         if stopped or preempted:
                             break
                     if stopped:
@@ -816,8 +892,14 @@ class SingleChipTrainer:
                     break
         end = time.perf_counter()
         train_time = timer.total_s
+        t_ev0 = time.perf_counter() if gp is not None else 0.0
         final_acc = guarded(lambda: evaluate(params, x_test, y_test),
                             dispatch_timeout, "final eval")
+        if gp is not None:
+            gp.add("eval", time.perf_counter() - t_ev0)
+            # Final publish: tail brackets land in the gauges even
+            # when no span follows them.
+            gp.publish()
         log(f"final accuracy: {final_acc}")
         self.params, self.opt_state = params, opt_state
         return TrainResult(

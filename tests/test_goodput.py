@@ -1,0 +1,464 @@
+"""Goodput attribution + streaming anomaly detection (ISSUE 11).
+
+The acceptance pins:
+
+- **Attribution identity**: per-span/per-tick phase times sum to the
+  observed wall time, on the trainer path (compute == the StepTimer's
+  own total EXACTLY; guard-skip share splits losslessly) and the serve
+  path (tick residual lands in host/idle, nothing on the floor).
+- **Off path unchanged**: no registry -> no goodput tracker, no
+  goodput gauges; warmup attributes nothing.
+- **Deterministic anomalies**: the seeded stall@RID injection and the
+  seeded bulk-burst scenario each fire their anomaly at IDENTICAL
+  detector ticks across two fresh runs — the host-state signals are
+  deterministic functions of the tick clock.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from ddl_tpu.obs import MetricRegistry, Tracer
+from ddl_tpu.obs.anomaly import (
+    AnomalyDetector,
+    AnomalyRule,
+    parse_anomaly_rules,
+)
+from ddl_tpu.obs.export import MetricsExporter
+from ddl_tpu.obs.goodput import (
+    GOODPUT_PHASES,
+    SERVE_PHASES,
+    TRAIN_PHASES,
+    GoodputTracker,
+    goodput_summary,
+)
+from ddl_tpu.data.lm import synthesize_mixed_traffic, synthesize_prompts
+from ddl_tpu.models.transformer import TINY_SPEC
+
+SPEC = TINY_SPEC
+
+
+def _phase_gauges(reg):
+    g = reg.gauge("time_in_seconds")
+    return {ls["phase"]: g.value(**ls) for ls in g.label_sets()}
+
+
+# -- GoodputTracker unit ------------------------------------------------------
+
+
+def test_goodput_tracker_identity_and_validation():
+    """Pure unit pin: adds and tick residuals always sum back to the
+    observed total; unknown phases/kinds and a missing registry are
+    loud errors; the gauges equal the tracker state after publish."""
+    reg = MetricRegistry()
+    with pytest.raises(ValueError, match="kind"):
+        GoodputTracker(reg, "router")
+    with pytest.raises(ValueError, match="registry"):
+        GoodputTracker(None, "serve")
+    gp = GoodputTracker(reg, "serve")
+    with pytest.raises(ValueError, match="unknown serve phase"):
+        gp.add("compute", 1.0)  # a train phase on a serve tracker
+    assert set(gp.phases) == set(SERVE_PHASES)
+    assert set(GoodputTracker(reg, "train").phases) == set(TRAIN_PHASES)
+
+    # A working tick: sub-brackets + residual == tick wall time.
+    gp.begin_tick()
+    gp.add("prefill", 0.25)
+    gp.add("decode", 0.5)
+    gp.end_tick()
+    # An idle tick: the whole residual files under idle.
+    gp.begin_tick()
+    gp.end_tick()
+    # A bookkeeping-only tick (work=False): residual is idle, the shed
+    # bracket still counts.
+    gp.begin_tick()
+    gp.add("shed", 0.01, work=False)
+    gp.end_tick()
+    assert math.isclose(gp.total_s, gp.observed_s, rel_tol=1e-9)
+    assert gp.phases["prefill"] == 0.25 and gp.phases["decode"] == 0.5
+    assert gp.phases["idle"] > 0.0 and gp.phases["shed"] == 0.01
+    assert gp.goodput_s == gp.phases["prefill"] + gp.phases["decode"]
+    assert GOODPUT_PHASES["serve"] == ("prefill", "decode")
+    gauges = _phase_gauges(reg)
+    assert gauges == gp.phases
+    assert reg.gauge("time_observed_seconds").value() == gp.observed_s
+    assert reg.gauge("goodput_fraction").value() == gp.goodput_fraction
+    with pytest.raises(RuntimeError, match="begin_tick"):
+        gp.end_tick()
+
+
+# -- AnomalyDetector unit -----------------------------------------------------
+
+
+def test_anomaly_rule_validation_and_grammar():
+    with pytest.raises(ValueError, match="window"):
+        AnomalyRule(signal="x", window=1)
+    with pytest.raises(ValueError, match="min_history"):
+        AnomalyRule(signal="x", window=4, min_history=5)
+    with pytest.raises(ValueError, match="threshold"):
+        AnomalyRule(signal="x", threshold=0)
+    with pytest.raises(ValueError, match="direction"):
+        AnomalyRule(signal="x", direction="up")
+    with pytest.raises(ValueError, match="min_scale"):
+        AnomalyRule(signal="x", min_scale=0)
+    rules = parse_anomaly_rules(
+        "itl:window=16,min=4,threshold=8,direction=high,scale=0.001;"
+        "pages_free:direction=low"
+    )
+    assert rules[0] == AnomalyRule(signal="itl", window=16, min_history=4,
+                                   threshold=8.0, direction="high",
+                                   min_scale=0.001)
+    assert rules[1].signal == "pages_free"
+    assert rules[1].direction == "low"
+    with pytest.raises(ValueError, match="no rules"):
+        parse_anomaly_rules(" ; ")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_anomaly_rules("a;a")
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_anomaly_rules("a:objective=0.9")
+    with pytest.raises(ValueError, match="duplicate anomaly signal"):
+        AnomalyDetector([AnomalyRule(signal="a"), AnomalyRule(signal="a")],
+                        MetricRegistry())
+    with pytest.raises(ValueError, match="MetricRegistry"):
+        AnomalyDetector([AnomalyRule(signal="a")], None)
+
+
+def test_anomaly_detector_median_mad_edge_trigger():
+    """The detection math, hand-checkable: a flat integer baseline has
+    MAD 0 (min_scale floors the scale, so the first deviation scores
+    decisively), a spike is scored BEFORE it joins the baseline, entry
+    is edge-triggered (a sustained excursion counts once), direction
+    filters the tail, and every emission surface agrees (counter,
+    last-tick gauge, fired_ticks, trace event)."""
+    reg, tr = MetricRegistry(), Tracer()
+    det = AnomalyDetector(
+        [AnomalyRule(signal="q", window=8, min_history=4, threshold=6,
+                     direction="high"),
+         AnomalyRule(signal="cap", window=8, min_history=4, threshold=6,
+                     direction="low")],
+        reg, tracer=tr,
+    )
+    # Ticks 1-4: flat baselines build; nothing can fire (cold history).
+    for _ in range(4):
+        assert det.tick({"q": 2, "cap": 10}) == []
+    assert det.baseline("q") == (2.0, 0.0)
+    # Tick 5: q spikes high -> fires; cap spikes HIGH -> direction=low
+    # stays silent.
+    assert det.tick({"q": 9, "cap": 99}) == ["q"]
+    # Tick 6: both excursions sustain -> edge-trigger: no new entry
+    # for q; cap drops low -> its first entry.
+    assert det.tick({"q": 9, "cap": 0}) == ["cap"]
+    # Tick 7: recovery clears the latch...
+    assert det.tick({"q": 2, "cap": 10}) == []
+    # ...tick 8: a fresh excursion is a NEW entry.
+    assert det.tick({"q": 9}) == ["q"]
+    assert det.alerts("q") == 2 and det.alerts("cap") == 1
+    assert det.fired_ticks("q") == [5, 8]
+    assert det.fired_ticks("cap") == [6]
+    assert reg.counter("anomaly_total").value(signal="q") == 2
+    assert reg.gauge("anomaly_last_tick").value(signal="q") == 8
+    events = [r for r in tr.records if r["name"] == "anomaly"]
+    assert [e["attrs"]["tick"] for e in events
+            if e["attrs"]["signal"] == "q"] == [5, 8]
+    ev = events[0]["attrs"]
+    assert ev["value"] == 9.0 and ev["median"] == 2.0 and ev["mad"] == 0.0
+    assert ev["z"] > 6
+    # A noisy baseline scores through 1.4826*MAD: [1,2,3,4] has
+    # median 2.5, MAD 1.0 -> z(9) = 6.5/1.4826 ~ 4.4 < 6: no fire.
+    det2 = AnomalyDetector(
+        [AnomalyRule(signal="s", window=8, min_history=4, threshold=6,
+                     direction="high")], MetricRegistry(),
+    )
+    for v in (1, 2, 3, 4):
+        det2.tick({"s": v})
+    assert det2.tick({"s": 9}) == []
+    assert det2.baseline("s") == (3.0, 1.0)  # 9 joined after scoring
+    with pytest.raises(KeyError, match="no anomaly rule"):
+        det2.fired_ticks("nope")
+
+
+# -- serve path: tick identity + off path ------------------------------------
+
+
+def test_serve_tick_identity_prefix_and_off_path():
+    """THE serve identity pin: a live run's phase times sum to the
+    observed tick wall time; prefill/decode come from the SAME
+    StepTimer brackets the histograms observe; the prefix-copy bracket
+    lands under prefix_copy; warmup attributes NOTHING; and without a
+    registry there is no tracker at all (off path)."""
+    from ddl_tpu.data.lm import synthesize_shared_prefix_prompts
+    from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+
+    prompts = synthesize_shared_prefix_prompts(
+        n_families=2, per_family=2, prefix_len=6, tail_min=2, tail_max=4,
+        vocab=SPEC.vocab, seed=3,
+    )
+    reqs = [Request(id=i, prompt=p, max_new_tokens=4, arrival=i)
+            for i, p in enumerate(prompts)]
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=32,
+                                      prefix_slots=2))
+    reg = MetricRegistry()
+    sched = Scheduler(eng, registry=reg)
+    assert sched.goodput is not None
+    sched.warmup(reqs)
+    assert sched.goodput.observed_s == 0.0, "warmup must attribute nothing"
+    done, stats = sched.run(reqs)
+    gp = sched.goodput
+    assert math.isclose(gp.total_s, gp.observed_s, rel_tol=1e-9)
+    assert gp.phases["prefill"] > 0 and gp.phases["decode"] > 0
+    assert gp.phases["prefix_copy"] > 0  # the staggered families hit
+    # The attribution reuses the StepTimer brackets EXACTLY: the
+    # prefill/decode phases are the histogram sums (same floats,
+    # accumulated in the same order).
+    assert gp.phases["prefill"] == \
+        sum(reg.histogram("serve_prefill_seconds").values())
+    assert gp.phases["decode"] == \
+        sum(reg.histogram("serve_decode_step_seconds").values())
+    gauges = _phase_gauges(reg)
+    assert gauges == gp.phases
+    assert reg.gauge("goodput_fraction").value() == gp.goodput_fraction
+    assert 0.0 < gp.goodput_fraction <= 1.0
+
+    # Off path: no registry -> no tracker, and the registry-less run
+    # publishes no goodput names anywhere.
+    eng2 = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=32))
+    sched2 = Scheduler(eng2)
+    assert sched2.goodput is None
+    sched2.run([Request(id=0, prompt=prompts[0], max_new_tokens=2)])
+
+
+def test_anomaly_registry_validation_scheduler_and_router():
+    from ddl_tpu.serve import InferenceEngine, Scheduler, ServeConfig
+    from ddl_tpu.serve.router import Router, RouterConfig
+
+    det = AnomalyDetector([AnomalyRule(signal="itl")], MetricRegistry())
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=1, capacity=16))
+    with pytest.raises(ValueError, match="different registry"):
+        Scheduler(eng, registry=MetricRegistry(), anomaly_detector=det)
+    # attach_registry enforces the same invariant: swapping the
+    # registry under a bound detector/monitor would strand its
+    # metrics (and unbind the anomaly feed's inputs).
+    reg2 = MetricRegistry()
+    det2 = AnomalyDetector([AnomalyRule(signal="itl")], reg2)
+    sched = Scheduler(eng, registry=reg2, anomaly_detector=det2)
+    with pytest.raises(ValueError, match="strand"):
+        sched.attach_registry(MetricRegistry())
+    with pytest.raises(ValueError, match="strand"):
+        sched.attach_registry(None)
+    sched.attach_registry(reg2)  # the SAME registry re-attaches fine
+    with pytest.raises(ValueError, match="different registry"):
+        Router(RouterConfig(serve=ServeConfig(spec=SPEC, slots=1,
+                                              capacity=16), replicas=1),
+               registry=MetricRegistry(), anomaly_detector=det)
+    with pytest.raises(ValueError, match="registry"):
+        Router(RouterConfig(serve=ServeConfig(spec=SPEC, slots=1,
+                                              capacity=16), replicas=1),
+               anomaly_detector=det)
+
+
+# -- the deterministic anomaly scenarios --------------------------------------
+
+
+def _stall_run():
+    """One seeded stall run: slots=2, four healthy requests decoding in
+    two waves, one stall@9-injected request whose TTFT deadline bounds
+    the run. The active_slots signal drops to 0 at every wave
+    completion tick — a deterministic function of the token schedule,
+    scored against a flat baseline of 2s."""
+    from ddl_tpu.resilience.faults import FaultInjector, parse_fault
+    from ddl_tpu.serve import InferenceEngine, Request, Scheduler, ServeConfig
+
+    prompts = synthesize_prompts(num=4, min_len=4, max_len=8,
+                                 vocab=SPEC.vocab, seed=7)
+    reqs = [Request(id=i, prompt=p, max_new_tokens=4)
+            for i, p in enumerate(prompts)]
+    reqs.append(Request(id=9, prompt=prompts[0], max_new_tokens=4,
+                        ttft_deadline_s=0.15))
+    inj = FaultInjector(parse_fault("stall@9"))
+    reg, tr = MetricRegistry(), Tracer()
+    det = AnomalyDetector(
+        [AnomalyRule(signal="active_slots", window=8, min_history=2,
+                     threshold=6, direction="low")], reg, tracer=tr)
+    eng = InferenceEngine(ServeConfig(spec=SPEC, slots=2, capacity=32))
+    sched = Scheduler(eng, registry=reg, tracer=tr, injector=inj,
+                      anomaly_detector=det)
+    done, _ = sched.run(reqs)
+    return det, done
+
+
+def test_stall_injection_anomaly_fires_at_identical_ticks():
+    """THE stall determinism pin: the stall@9 scenario fires the
+    active_slots anomaly, every firing happens BEFORE wall-clock
+    behavior (the deadline spin) can perturb the tick count, and two
+    fresh runs fire at IDENTICAL detector ticks."""
+    det1, done1 = _stall_run()
+    assert done1[9].status == "deadline_exceeded"  # the stall was real
+    assert det1.alerts("active_slots") >= 1
+    assert det1.fired_ticks("active_slots")
+    det2, done2 = _stall_run()
+    assert det2.fired_ticks("active_slots") == \
+        det1.fired_ticks("active_slots")
+    assert det2.alerts("active_slots") == det1.alerts("active_slots")
+    assert [done2[i].tokens for i in sorted(done2)] == \
+        [done1[i].tokens for i in sorted(done1)]
+
+
+def _burst_anomaly_run():
+    """The ISSUE-10 seeded bulk-burst scenario, scored by the router's
+    backlog anomaly signal instead of (only) the SLO monitor."""
+    from ddl_tpu.serve import ServeConfig
+    from ddl_tpu.serve.router import ClassSpec, Router, RouterConfig
+
+    traffic = synthesize_mixed_traffic(
+        classes={
+            "chat": dict(rate=0.3, prompt_min=4, prompt_max=8,
+                         max_new_tokens=2),
+            "bulk": dict(rate=0.4, prompt_min=4, prompt_max=8,
+                         max_new_tokens=2),
+        },
+        horizon=16, vocab=SPEC.vocab, seed=0,
+        burst=(4, 6, 6.0, "bulk"), max_requests=16,
+    )
+    reg, tr = MetricRegistry(), Tracer()
+    det = AnomalyDetector(
+        [AnomalyRule(signal="backlog", window=8, min_history=3,
+                     threshold=6, direction="high"),
+         AnomalyRule(signal="shed_rate", window=8, min_history=3,
+                     threshold=6, direction="high")], reg, tracer=tr)
+    cfg = RouterConfig(
+        serve=ServeConfig(spec=SPEC, slots=1, capacity=64),
+        replicas=1,
+        classes=(ClassSpec("chat", priority=0),
+                 ClassSpec("bulk", priority=1, shed_margin=1)),
+        shed_threshold=2,
+    )
+    router = Router(cfg, registry=reg, tracer=tr, anomaly_detector=det)
+    router.run(traffic)
+    return det, tr
+
+
+def test_bulk_burst_anomaly_fires_at_identical_ticks():
+    """THE burst determinism pin: the seeded bulk burst drives the
+    fleet backlog over its rolling baseline — the anomaly fires, lands
+    in the trace, and two fresh runs (fresh router, registry,
+    detector) fire at IDENTICAL detector ticks."""
+    det1, tr1 = _burst_anomaly_run()
+    assert det1.alerts("backlog") >= 1
+    assert det1.fired_ticks("backlog")
+    assert any(r["name"] == "anomaly"
+               and r["attrs"]["signal"] == "backlog"
+               for r in tr1.records)
+    det2, _ = _burst_anomaly_run()
+    for sig in ("backlog", "shed_rate"):
+        assert det2.fired_ticks(sig) == det1.fired_ticks(sig)
+        assert det2.alerts(sig) == det1.alerts(sig)
+
+
+# -- trainer path -------------------------------------------------------------
+
+
+def test_trainer_goodput_identity_and_anomaly_feed(tmp_path):
+    """THE trainer identity pin: compute phase == the trainer's own
+    train_time_s EXACTLY (same floats, same order), every phase the run
+    exercised is nonzero, phases sum to the observed total, and the
+    anomaly detector is scored once per span."""
+    from ddl_tpu.data.lm import synthesize_copy
+    from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+
+    reg = MetricRegistry()
+    det = AnomalyDetector(
+        [AnomalyRule(signal="step_time", min_history=2, threshold=50,
+                     direction="high")], reg)
+    ds = synthesize_copy(num_train=64, num_test=16, seq_len=16, vocab=32,
+                         seed=0)
+    cfg = SeqConfig(epochs=1, batch_size=16, eval_every=2, seed=0,
+                    num_workers=1, scheme="full")
+    trainer = SeqTrainer(cfg, ds)
+    res = trainer.train(log=lambda s: None, metrics=reg,
+                        checkpoint_dir=str(tmp_path),
+                        anomaly_detector=det)
+    gauges = _phase_gauges(reg)
+    observed = reg.gauge("time_observed_seconds").value()
+    assert math.isclose(sum(gauges.values()), observed, rel_tol=1e-9)
+    assert gauges["compute"] == res.train_time_s  # EXACT, same floats
+    for phase in ("staging", "compile", "eval", "checkpoint_io"):
+        assert gauges[phase] > 0, phase
+    assert gauges["stall"] == 0.0  # nothing skipped
+    assert reg.gauge("goodput_fraction").value() == \
+        gauges["compute"] / observed
+    # One detector tick per dispatched span: eval_every=2 over 4
+    # batches -> spans [0], [1..2], [3].
+    assert det.ticks == 3
+
+    # A detector on a foreign registry is a loud error.
+    det2 = AnomalyDetector([AnomalyRule(signal="mfu")], MetricRegistry())
+    with pytest.raises(ValueError, match="registry"):
+        SeqTrainer(cfg, ds).train(log=lambda s: None, metrics=reg,
+                                  anomaly_detector=det2)
+
+
+def test_trainer_guard_skip_stall_attribution(tmp_path):
+    """A guarded span with injected NaN steps re-files the skipped
+    share as stall — and the split is LOSSLESS: compute + stall still
+    equal the trainer's own span total exactly."""
+    from ddl_tpu.data.lm import synthesize_copy
+    from ddl_tpu.resilience.faults import FaultInjector, parse_fault
+    from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+
+    reg = MetricRegistry()
+    ds = synthesize_copy(num_train=32, num_test=16, seq_len=16, vocab=32,
+                         seed=0)
+    cfg = SeqConfig(epochs=1, batch_size=16, eval_every=0, seed=0,
+                    num_workers=1, scheme="full")
+    trainer = SeqTrainer(cfg, ds)
+    res = trainer.train(log=lambda s: None, metrics=reg,
+                        checkpoint_dir=str(tmp_path), guard=True,
+                        fault_injector=FaultInjector(
+                            parse_fault("nan_grads@0")))
+    assert res.skipped_steps >= 1
+    gauges = _phase_gauges(reg)
+    assert gauges["stall"] > 0.0
+    assert gauges["compute"] + gauges["stall"] == \
+        pytest.approx(res.train_time_s, rel=1e-12)
+    assert math.isclose(
+        sum(gauges.values()),
+        reg.gauge("time_observed_seconds").value(), rel_tol=1e-9,
+    )
+
+
+# -- /healthz digest ----------------------------------------------------------
+
+
+def test_healthz_goodput_summary_live_and_unit():
+    """goodput_summary reads NON-creatingly (an empty registry stays
+    empty) and the /healthz endpoint surfaces fraction + last anomaly
+    tick once a detector fired."""
+    reg = MetricRegistry()
+    assert goodput_summary(reg) == {}
+    assert not [m.name for m in reg.metrics()], \
+        "summary of an empty registry must not create metrics"
+    gp = GoodputTracker(reg, "serve")
+    gp.begin_tick()
+    gp.add("decode", 0.05)
+    gp.end_tick()
+    det = AnomalyDetector(
+        [AnomalyRule(signal="q", min_history=2, threshold=6,
+                     direction="high")], reg)
+    for v in (1, 1, 1, 9):
+        det.tick({"q": v})
+    summary = goodput_summary(reg)
+    assert summary["goodput_fraction"] == gp.goodput_fraction
+    assert summary["last_anomaly_tick"] == det.fired_ticks("q")[0]
+    assert summary["anomalies_total"] == 1
+    with MetricsExporter(reg, 0) as exp:
+        health = json.loads(urllib.request.urlopen(
+            exp.url("/healthz")
+        ).read())
+    assert health["status"] == "ok"
+    assert health["goodput_fraction"] == gp.goodput_fraction
+    assert health["last_anomaly_tick"] == 4
+    assert health["anomalies_total"] == 1

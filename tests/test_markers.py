@@ -116,12 +116,14 @@ def estimate(fn) -> tuple[bool, int, int]:
         if id(node) in skip:
             continue
         if isinstance(node, ast.Name) and node.id in (
-            "Scheduler", "Router", "SloMonitor",
+            "Scheduler", "Router", "SloMonitor", "AnomalyDetector",
+            "GoodputTracker",
         ):
-            # SloMonitor (ISSUE 10): the SLO tests drive schedulers/
-            # routers through the monitor surface — a monitor name
-            # alone marks the test as scheduler-driving, so the new
-            # SLO/export tests count into the same budgets.
+            # SloMonitor (ISSUE 10) / AnomalyDetector + GoodputTracker
+            # (ISSUE 11): the SLO/anomaly/goodput tests drive
+            # schedulers and routers through those surfaces — any of
+            # these names alone marks the test as scheduler-driving,
+            # so the observability tests count into the same budgets.
             uses_scheduler = True
         if isinstance(node, ast.For) and isinstance(
             node.iter, (ast.Tuple, ast.List)
@@ -368,6 +370,37 @@ def test_slo_audit_estimator_extension():
     assert uses and tokens == 160 and topo == 1
     uses, tokens, _ = estimate(fns["test_slo_in_budget"])
     assert uses and tokens == 20
+
+
+def test_anomaly_goodput_audit_estimator_extension():
+    """ISSUE 11 self-pin: an ``AnomalyDetector`` or ``GoodputTracker``
+    name alone marks a test as scheduler-driving (the goodput/anomaly
+    tests drive serving through those surfaces), so token overruns in
+    the new observability tests flag exactly like direct
+    Scheduler/Router tests; in-budget ones stay exempt-by-budget."""
+    src = textwrap.dedent("""
+        def test_anomaly_token_overrun():
+            det = AnomalyDetector([rule], reg)
+            prompts = synthesize_prompts(num=10, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=20)
+                    for i, p in enumerate(prompts)]
+            drive(det, reqs)
+
+        def test_goodput_in_budget():
+            gp = GoodputTracker(reg, "serve")
+            prompts = synthesize_prompts(num=4, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=4)
+                    for i, p in enumerate(prompts)]
+            drive(gp, reqs)
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_anomaly_token_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_anomaly_token_overrun"])
+    assert uses and tokens == 200 and topo == 1
+    uses, tokens, _ = estimate(fns["test_goodput_in_budget"])
+    assert uses and tokens == 16
 
 
 def test_fault_injection_tests_carry_slow_marker():

@@ -173,6 +173,70 @@ def test_trace_jsonl_roundtrip_and_chrome_conversion(tmp_path):
     assert span["dur"] >= 0 and span["ts"] > 0
 
 
+def test_chrome_conversion_incident_flow_roundtrip(tmp_path):
+    """ISSUE 11 satellite: incident instants (anomaly, guard_skip,
+    shed, ...) render as GLOBAL-scope instants under cat="incident",
+    chained by flow (s/t/f) events along their identity — a request's
+    shed flows to its completion record, guard skips to the rollback
+    that resolves them, consecutive anomalies of one signal to each
+    other — and the file converter round-trips all of it."""
+    import json
+
+    from ddl_tpu.obs.trace import INCIDENT_EVENTS, convert
+
+    path = tmp_path / "host_trace_p0.jsonl"
+    tr = Tracer(path, keep=True)
+    tr.event("eligible", t=1.0, req=7)          # plain lifecycle: no flow
+    tr.event("deadline_exceeded", t=2.0, req=7)  # incident opens req chain
+    tr.event("complete", t=3.0, req=7, status="deadline_exceeded")
+    tr.event("complete", t=3.5, req=8, status="ok")  # no incident: no chain
+    tr.event("anomaly", t=4.0, signal="itl", tick=4, z=9.0)
+    tr.event("anomaly", t=5.0, signal="itl", tick=5, z=7.0)
+    tr.event("guard_skip", t=6.0, gstep=3, consecutive=1)
+    tr.event("guard_skip", t=6.5, gstep=4, consecutive=2)
+    tr.event("guard_rollback", t=7.0, to_step=2, rollbacks=1)
+    tr.event("shed", t=8.0, req=9, step=8)      # 1-length chain: no flow
+    tr.close()
+    evs = chrome_trace_events(tr.records)
+    instants = {e["name"]: e for e in evs if e["ph"] == "i"}
+    for name in ("deadline_exceeded", "anomaly", "guard_skip", "shed"):
+        assert name in INCIDENT_EVENTS
+        assert instants[name]["s"] == "g"
+        assert instants[name]["cat"] == "incident"
+    # Plain events keep thread scope and no category.
+    assert instants["eligible"]["s"] == "t"
+    assert "cat" not in instants["eligible"]
+    flows = [e for e in evs if e.get("cat") == "incident_flow"]
+    by_chain: dict = {}
+    for f in flows:
+        by_chain.setdefault(f["name"], []).append(f)
+    # Three chains: req=7 (incident -> complete), signal=itl (two
+    # anomalies), guard (2 skips -> rollback). req=8's complete and the
+    # lone shed open no chain.
+    assert set(by_chain) == {"incident:req=7", "incident:signal=itl",
+                             "incident:guard=train"}
+    for name, chain in by_chain.items():
+        chain.sort(key=lambda e: e["ts"])
+        phs = [e["ph"] for e in chain]
+        assert phs[0] == "s" and phs[-1] == "f"
+        assert set(phs[1:-1]) <= {"t"}
+        assert len({e["id"] for e in chain}) == 1  # one flow id per chain
+        assert chain[-1]["bp"] == "e"
+    assert [e["ph"] for e in by_chain["incident:guard=train"]] == \
+        ["s", "t", "f"]
+    # Flow ids are distinct across chains and deterministic.
+    ids = {chain[0]["id"] for chain in by_chain.values()}
+    assert len(ids) == 3
+    assert chrome_trace_events(tr.records) == evs  # deterministic
+    # File round-trip: convert() writes a loadable trace_event JSON
+    # carrying every instant AND every flow event.
+    dst = tmp_path / "chrome.json"
+    n = convert(path, dst)
+    doc = json.loads(dst.read_text())
+    assert len(doc["traceEvents"]) == n == len(evs)
+    assert doc["traceEvents"] == evs
+
+
 def test_metrics_writer_manifest_first_and_snapshot_roundtrip(tmp_path):
     from ddl_tpu.strategies.seq import SeqConfig
 

@@ -507,7 +507,13 @@ def test_metrics_endpoint_byte_identical_during_live_serve_run():
         health = json.loads(urllib.request.urlopen(
             exp.url("/healthz")
         ).read())
-        assert health == {"status": "ok"}
+        # ISSUE 11: /healthz carries the compact goodput digest next
+        # to liveness — equal to the live gauge, absent keys for
+        # detectors this run never attached.
+        assert health["status"] == "ok"
+        assert health["goodput_fraction"] == \
+            reg.gauge("goodput_fraction").value()
+        assert "last_anomaly_tick" not in health
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(exp.url("/nope"))
         assert e.value.code == 404
