@@ -42,6 +42,13 @@ Plus head-to-head sections (ISSUE 4/7; skip with ``--skip-compare``):
   ``chat_shed`` row records any strays — affinity CONCENTRATES family
   traffic, which can cost a straggler on the loaded replica, a trade
   the A/B makes visible instead of hiding).
+- **fleet_compare** (ISSUE 13) — the self-healing fleet: the seeded
+  bulk-burst scenario served by a static shed-only fleet vs the same
+  seed fleet under the autoscale controller (scale-out on sustained
+  pressure, drain-before-removal on idle). Per-class TTFT/ITL SLO
+  attainment, the shed ledger, the controller's scale-event digest and
+  an observed-time-weighted goodput fraction — all read from the
+  registries.
 - **longtail_compare** (ISSUE 7) — capacity POOLING made concrete: a
   long-tail prompt mix under one fixed row budget. The slot-major arm
   (budget / slots rows per slot) must REJECT the long requests at
@@ -574,6 +581,86 @@ def main() -> None:
                         "error": str(e)[:300],
                     }
 
+    # -- fleet controller (ISSUE 13): shed-only vs autoscale on the
+    # bulk-burst scenario — per-class SLO attainment and goodput read
+    # from the registries, scale/drain/preempt ledger from the
+    # controller digest -----------------------------------------------------
+    fleet_compare = {}
+    if not args.skip_compare:
+        from ddl_tpu.data.lm import synthesize_mixed_traffic
+        from ddl_tpu.serve import (
+            AutoscaleConfig,
+            ClassSpec,
+            FleetController,
+            Router,
+            RouterConfig,
+        )
+
+        def _fleet_goodput(router):
+            """Observed-time-weighted goodput fraction over the live
+            replica registries (each replica publishes its own
+            goodput_fraction / time_observed_seconds gauges)."""
+            num = den = 0.0
+            for reg in router.replica_registries or ():
+                gf = reg.get("goodput_fraction")
+                ts = reg.get("time_observed_seconds")
+                if gf is None or ts is None or gf.value() is None \
+                        or ts.value() is None:
+                    continue
+                num += gf.value() * ts.value()
+                den += ts.value()
+            return round(num / den, 4) if den else None
+
+        if left() < 240:
+            note = "deadline: fleet_compare skipped"
+            fleet_compare["skipped"] = note
+            print(f"[serve_bench] {note}", file=sys.stderr)
+        else:
+            fl_traffic = synthesize_mixed_traffic(
+                classes={
+                    "chat": dict(rate=0.4, prompt_min=8, prompt_max=24,
+                                 max_new_tokens=8),
+                    "bulk": dict(rate=0.5, prompt_min=8, prompt_max=24,
+                                 max_new_tokens=8),
+                },
+                horizon=20, vocab=args.vocab, seed=8,
+                burst=(4, 8, 5.0, "bulk"), max_requests=28,
+            )
+            fl_base = RouterConfig(
+                serve=ServeConfig(**{**base_cfg, "slots": 2}),
+                replicas=1,
+                classes=(ClassSpec("chat", ttft_slo_s=5.0, itl_slo_s=0.5,
+                                   priority=0),
+                         ClassSpec("bulk", ttft_slo_s=60.0, itl_slo_s=5.0,
+                                   priority=2, shed_margin=2)),
+                shed_threshold=5,
+            )
+            for label, scale in (("shed_only", False), ("autoscale", True)):
+                try:
+                    ctrl = FleetController(AutoscaleConfig(
+                        max_replicas=3, min_replicas=1,
+                        backlog_per_replica=3.0, sustain_ticks=2,
+                        idle_ticks=6,
+                    )) if scale else None
+                    router = Router(fl_base, registry=MetricRegistry(),
+                                    controller=ctrl)
+                    router.warmup(fl_traffic)
+                    done, rs = router.run(fl_traffic)
+                    row = rs.summary()
+                    row["goodput_fraction"] = _fleet_goodput(router)
+                    fleet_compare[label] = row
+                    chat = row["per_class"].get("chat", {})
+                    bulk = row["per_class"].get("bulk", {})
+                    print(f"[serve_bench] fleet {label}: chat ttft slo "
+                          f"{chat.get('ttft_slo_attained', 0):.0%}, bulk "
+                          f"shed {bulk.get('shed', 0)}, goodput "
+                          f"{row['goodput_fraction']}", file=sys.stderr)
+                except Exception as e:  # noqa: BLE001
+                    failed[f"fleet_{label}"] = {
+                        "error_type": type(e).__name__,
+                        "error": str(e)[:300],
+                    }
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -651,6 +738,7 @@ def main() -> None:
         "paged_compare": paged_compare,
         "longtail_compare": longtail_compare,
         "router_compare": router_compare,
+        "fleet_compare": fleet_compare,
         "prefix_len": args.prefix_len,
         "prefill_chunk": args.prefill_chunk,
         "page_size": args.page_size,

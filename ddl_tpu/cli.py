@@ -439,6 +439,26 @@ def build_parser() -> argparse.ArgumentParser:
                          "label.class= (observed live per global tick); "
                          "serve_* histograms live in per-replica "
                          "registries and are invisible to it")
+    sv.add_argument("--autoscale", default=None, metavar="SPEC",
+                    help="self-healing fleet controller for --replicas "
+                         "(ddl_tpu.serve.controller): comma-joined "
+                         "key=val — max=N (fleet cap; --max-replicas "
+                         "overrides), min=N (floor; default: --replicas), "
+                         "backlog=F (mean outstanding per replica that "
+                         "triggers scale-out), sustain=N (ticks), idle=N "
+                         "(idle ticks before a drain), preempt=0|1, "
+                         "wait=N/gap=N (preemption wait ticks / priority "
+                         "gap), burn=RULE|RULE (--slo-rules names whose "
+                         "alert condition also triggers scale-out). "
+                         "Scales out on sustained pressure/burns (door "
+                         "shed defers while the fleet can grow), drains "
+                         "before scale-in, heals replica crashes, and "
+                         "preempts cross-replica on paged engines. Empty "
+                         "SPEC ('') with --max-replicas uses defaults")
+    sv.add_argument("--max-replicas", type=int, default=None, metavar="N",
+                    help="fleet cap for --autoscale (overrides its max= "
+                         "key); every replica is a full engine — compiled "
+                         "programs + its own KV pool")
     sv.add_argument("--slo", default=None, metavar="SPEC",
                     help="per-class SLO targets/priorities for "
                          "--replicas: ';'-joined NAME:ttft=S,itl=S,"
@@ -680,7 +700,7 @@ _SERVE_ONLY_DESTS = (
     "slots", "capacity", "max_new_tokens", "num_prompts", "prompt_min",
     "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
     "prefill_budget", "ttft_deadline", "request_deadline", "shed_threshold",
-    "replicas", "traffic", "slo", "slo_rules",
+    "replicas", "traffic", "slo", "slo_rules", "autoscale", "max_replicas",
 )
 
 
@@ -1113,18 +1133,36 @@ def _run_serve_router(args, cfg) -> int:
     monitor = _make_slo_monitor(args, registry, tracer)
     detector = _make_anomaly(args, registry, tracer)
     injector = _make_injector(args, "serve")
+    controller = None
+    if args.autoscale is not None:
+        from .serve.controller import FleetController, parse_autoscale_spec
+
+        try:
+            acfg = parse_autoscale_spec(args.autoscale,
+                                        max_replicas=args.max_replicas,
+                                        replicas=args.replicas)
+        except ValueError as e:
+            raise SystemExit(f"--autoscale: {e}")
+        controller = FleetController(acfg, injector=injector)
+    if injector is not None and injector.spec.kind == "replica_crash" \
+            and controller is None:
+        raise SystemExit(
+            "--inject-fault replica_crash needs --autoscale (only the "
+            "fleet controller delivers the crash and heals the fleet)"
+        )
     try:
         router = (
             Router.from_checkpoint(rcfg, ckpt, registry=registry,
                                    tracer=tracer, injector=injector,
                                    slo_monitor=monitor,
                                    peak_flops=args.peak_flops,
-                                   anomaly_detector=detector)
+                                   anomaly_detector=detector,
+                                   controller=controller)
             if ckpt is not None else
             Router(rcfg, registry=registry, tracer=tracer,
                    injector=injector, slo_monitor=monitor,
                    peak_flops=args.peak_flops,
-                   anomaly_detector=detector)
+                   anomaly_detector=detector, controller=controller)
         )
     except (ValueError, KeyError) as e:
         raise SystemExit(f"serve config error: {e}")
@@ -1171,6 +1209,12 @@ def _run_serve_router(args, cfg) -> int:
           f"{rstats.affinity_placements}, load {rstats.load_placements}) "
           f"| router sheds {rstats.router_sheds} | prefix hit rate "
           f"{rstats.prefix_hit_rate:.0%}")
+    if rstats.fleet is not None:
+        fl = rstats.fleet
+        print(f"fleet: max {fl['max_replicas']} | scale out "
+              f"{fl['scale_outs']} in {fl['scale_ins']} (drains "
+              f"{fl['drains']}) | preemptions {fl['preemptions']} | "
+              f"crashes {fl['crashes']} (requeues {fl['requeues']})")
     if args.json:
         print(json.dumps({
             "variant": "serve",
@@ -1244,6 +1288,16 @@ def _run_serve(args) -> int:
         raise SystemExit("--traffic requires --replicas (the router path)")
     if args.slo is not None and args.replicas is None:
         raise SystemExit("--slo requires --replicas (the router path)")
+    if args.autoscale is not None and args.replicas is None:
+        raise SystemExit(
+            "--autoscale requires --replicas (the fleet controller "
+            "drives the router)"
+        )
+    if args.max_replicas is not None and args.autoscale is None:
+        raise SystemExit(
+            "--max-replicas requires --autoscale (it caps the fleet "
+            "the controller may grow; pass --autoscale '' for defaults)"
+        )
     if args.replicas is not None:
         return _run_serve_router(args, cfg)
     if args.max_new_tokens < 1:
@@ -1290,6 +1344,13 @@ def _run_serve(args) -> int:
     monitor = _make_slo_monitor(args, registry)
     detector = _make_anomaly(args, registry)
     injector = _make_injector(args, "serve")
+    if injector is not None and injector.spec.kind == "replica_crash":
+        # The bare scheduler never consults crashes_replica — silently
+        # dropping the fault would fake a passing chaos run.
+        raise SystemExit(
+            "--inject-fault replica_crash needs --replicas and "
+            "--autoscale (only the fleet controller delivers the crash)"
+        )
     try:
         scheduler = Scheduler(
             engine, registry=registry, metrics_writer=writer,

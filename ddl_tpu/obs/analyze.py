@@ -48,7 +48,7 @@ import json
 import math
 import sys
 
-from .trace import read_jsonl
+from .trace import FLEET_EVENTS, read_jsonl
 
 # Span-name -> goodput phase for the trace-side attribution (the
 # live-gauge taxonomy of obs.goodput, minus the residual-only phases).
@@ -63,8 +63,15 @@ SPAN_PHASE = {
 }
 GOODPUT_SPAN_PHASES = ("prefill", "decode", "compute")
 
+# Fleet-incident table rows (ISSUE 13 satellite): every scale / drain /
+# preempt / crash event, in trace order, with its tick and actors —
+# the SAME tuple the Chrome converter renders under cat=incident
+# (obs.trace.FLEET_EVENTS), so the two surfaces cannot drift.
+_FLEET_NAMES = FLEET_EVENTS
+
 _INCIDENT_NAMES = ("guard_skip", "guard_rollback", "shed", "router_shed",
-                   "deadline_exceeded", "slo_alert", "anomaly")
+                   "deadline_exceeded", "slo_alert", "anomaly",
+                   *_FLEET_NAMES)
 
 
 def _emit(line: str = "") -> None:
@@ -215,6 +222,14 @@ def build_report(records, top: int = 5) -> dict:
         name: sum(1 for r in records if r.get("name") == name)
         for name in _INCIDENT_NAMES
     }
+    fleet = [
+        {"kind": r["name"],
+         "tick": r["attrs"].get("tick", r["attrs"].get("step")),
+         **{k: r["attrs"][k]
+            for k in ("replica", "req", "src", "dst", "reason")
+            if k in r["attrs"]}}
+        for r in records if r.get("name") in _FLEET_NAMES
+    ]
     return {
         "spans": {n: spans[n] for n in sorted(spans)},
         "goodput": {
@@ -230,6 +245,7 @@ def build_report(records, top: int = 5) -> dict:
         "stragglers": stragglers,
         "anomalies": anomalies,
         "incidents": incidents,
+        "fleet_incidents": fleet,
     }
 
 
@@ -262,6 +278,13 @@ def _print_report(rep: dict) -> None:
         for a in rep["anomalies"]:
             _emit(f"  tick {a['tick']}: {a['signal']} value {a['value']} "
                   f"z {a['z']:.1f}")
+    if rep.get("fleet_incidents"):
+        _emit("fleet incidents:")
+        for f in rep["fleet_incidents"]:
+            who = " ".join(f"{k}={f[k]}"
+                           for k in ("replica", "req", "src", "dst",
+                                     "reason") if k in f)
+            _emit(f"  tick {f.get('tick')}: {f['kind']:<14} {who}")
     hits = {k: v for k, v in rep["incidents"].items() if v}
     if hits:
         _emit("incidents: " + ", ".join(f"{k}={v}"

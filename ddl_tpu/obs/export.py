@@ -80,17 +80,19 @@ class MetricsExporter:
                     self._send(200, body, _CONTENT_TYPE)
                 elif path == "/healthz":
                     # Compact goodput/degradation digest (ISSUE 11
-                    # satellite): probes see the current goodput
-                    # fraction and the last anomaly/SLO-alert tick
-                    # without scraping /metrics. Read NON-creatingly
+                    # satellite) + the fleet digest (ISSUE 13: replicas
+                    # active/draining, last scale tick, preemptions):
+                    # probes see degradation AND fleet churn without
+                    # scraping /metrics. Read NON-creatingly
                     # (registry.get) with the same mutation-race
                     # retry discipline as /metrics.
-                    from .goodput import goodput_summary
+                    from .goodput import fleet_summary, goodput_summary
 
                     body = {"status": "ok"}
                     for attempt in range(_SNAPSHOT_RETRIES):
                         try:
                             body.update(goodput_summary(exporter.registry))
+                            body.update(fleet_summary(exporter.registry))
                             break
                         except RuntimeError:
                             if attempt == _SNAPSHOT_RETRIES - 1:

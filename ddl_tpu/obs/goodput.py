@@ -242,9 +242,35 @@ def goodput_summary(registry) -> dict:
     return out
 
 
+def fleet_summary(registry) -> dict:
+    """Compact fleet digest read NON-CREATINGLY from a registry (the
+    ``/healthz`` surface, ISSUE 13 satellite — same
+    ``MetricRegistry.get`` pattern as :func:`goodput_summary`):
+    replicas active/draining, the last scale-event tick, and the
+    cumulative preemption count. Missing metrics are simply absent — a
+    run without a fleet controller reports nothing here, and reading
+    never mutates the registry."""
+    out: dict = {}
+    for key, name in (("replicas_active", "fleet_replicas_active"),
+                      ("replicas_draining", "fleet_replicas_draining"),
+                      ("last_scale_tick", "fleet_last_scale_tick")):
+        g = registry.get(name)
+        if g is not None and g.kind == "gauge":
+            v = g.value()
+            if v is not None:
+                out[key] = int(v)
+    c = registry.get("preemptions_total")
+    if c is not None and c.kind == "counter":
+        out["preemptions_total"] = int(sum(
+            c.value(**ls) for ls in c.label_sets()
+        ))
+    return out
+
+
 __all__ = [
     "GoodputTracker",
     "attribute_train_span",
+    "fleet_summary",
     "goodput_summary",
     "TRAIN_PHASES",
     "SERVE_PHASES",

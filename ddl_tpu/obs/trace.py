@@ -214,23 +214,36 @@ def trace_context(trace_dir: str | os.PathLike | None):
 # arrows so the timeline shows WHERE the incident's time went — a
 # guard_skip flows to its guard_rollback, a shed to the request's
 # completion record, consecutive anomalies of one signal to each other.
+# Fleet incidents (ISSUE 13): scale/drain/crash render full-height; a
+# preempt flows to its resume (both carry req) and on to the request's
+# completion record — the timeline shows the hand-off. ONE definition:
+# the analyze report's fleet-incident table reads this same tuple, so
+# the two surfaces cannot drift.
+FLEET_EVENTS = ("scale_out", "scale_in", "drain", "preempt", "resume",
+                "preempt_move", "replica_crash", "requeue")
+
 INCIDENT_EVENTS = frozenset({
     "anomaly", "guard_skip", "guard_rollback", "shed", "router_shed",
     "deadline_exceeded", "slo_alert",
+    *FLEET_EVENTS,
 })
 
 
 def _flow_key(name: str, attrs: dict):
     """The identity a flow chain follows: the request for lifecycle
-    incidents, the signal for anomalies, the rule for SLO alerts, one
-    shared chain for the trainer guard (its skips flow into the
-    rollback that resolves them)."""
+    incidents (a preempt chains to its resume to the completion), the
+    signal for anomalies, the rule for SLO alerts, the replica for
+    fleet scale/drain/crash events (a drain flows into the scale_in
+    that removes the replica), one shared chain for the trainer guard
+    (its skips flow into the rollback that resolves them)."""
     if "req" in attrs:
         return ("req", attrs["req"])
     if "signal" in attrs:
         return ("signal", attrs["signal"])
     if "rule" in attrs:
         return ("rule", attrs["rule"])
+    if "replica" in attrs:
+        return ("replica", attrs["replica"])
     if name.startswith("guard_"):
         return ("guard", "train")
     return None

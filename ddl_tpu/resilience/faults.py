@@ -23,6 +23,12 @@ so the test suite (tests/test_resilience.py) and manual chaos runs
 - ``stall@RID`` — the serve scheduler never advances request ``RID``'s
   prefill (an upstream hang), so its deadline must evict it and release
   its pinned prefix refs.
+- ``replica_crash@T:R`` — kill serve-fleet replica ``R`` at GLOBAL tick
+  ``T`` (a VM preemption / device loss mid-serve): the replica's engine
+  and page pool are discarded wholesale, its in-flight and queued
+  requests re-queue at the front door, and the fleet controller
+  (``serve.controller``) must heal — every request still completes
+  exactly once. Fires once; deterministic on the tick clock.
 
 Injection is host-side only — staged data, signals, files — so the
 compiled programs under test are the production programs, bit for bit.
@@ -38,21 +44,24 @@ import numpy as np
 
 TRAIN_KINDS = ("nan_grads", "inf_grads", "sigterm")
 CKPT_KINDS = ("corrupt_ckpt", "truncate_ckpt")
-SERVE_KINDS = ("stall",)
+SERVE_KINDS = ("stall", "replica_crash")
 KINDS = TRAIN_KINDS + CKPT_KINDS + SERVE_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One deterministic fault. ``step`` is the trigger global step
-    (train kinds) or the target request id (``stall``); ``count``
-    extends a grad fault over consecutive batches; ``once=True`` makes
-    a grad fault transient (healed by a guard rollback)."""
+    (train kinds), the target request id (``stall``), or the global
+    tick (``replica_crash``); ``replica`` is the ``replica_crash``
+    victim's id; ``count`` extends a grad fault over consecutive
+    batches; ``once=True`` makes a grad fault transient (healed by a
+    guard rollback)."""
 
     kind: str
     step: int = 0
     count: int = 1
     once: bool = True
+    replica: int = 0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -65,20 +74,36 @@ class FaultSpec:
                 f"fault {self.kind}: need step >= 0 and count >= 1, got "
                 f"step={self.step} count={self.count}"
             )
+        if self.replica < 0:
+            raise ValueError(
+                f"fault {self.kind}: replica must be >= 0, got "
+                f"{self.replica}"
+            )
 
 
 def parse_fault(text: str) -> FaultSpec:
     """CLI syntax: ``kind``, ``kind@STEP`` or ``kind@STEPxCOUNT`` —
     e.g. ``nan_grads@3``, ``nan_grads@3x2``, ``sigterm@5``,
-    ``stall@7``, ``corrupt_ckpt``. A trailing ``!`` makes a grad fault
-    persistent (``once=False``): ``nan_grads@3x2!``."""
+    ``stall@7``, ``corrupt_ckpt``. ``replica_crash`` takes
+    ``replica_crash@TICK:REPLICA``. A trailing ``!`` makes a grad
+    fault persistent (``once=False``): ``nan_grads@3x2!``."""
     once = True
     if text.endswith("!"):
         once = False
         text = text[:-1]
     kind, at, rest = text.partition("@")
-    step, count = 0, 1
-    if at:
+    step, count, replica = 0, 1, 0
+    if kind == "replica_crash":
+        head, colon, tail = rest.partition(":")
+        try:
+            step = int(head) if at else 0
+            replica = int(tail) if colon else 0
+        except ValueError:
+            raise ValueError(
+                f"bad fault spec {text!r}: replica_crash takes "
+                "replica_crash@TICK:REPLICA with integer TICK/REPLICA"
+            )
+    elif at:
         head, x, tail = rest.partition("x")
         try:
             step = int(head)
@@ -88,7 +113,8 @@ def parse_fault(text: str) -> FaultSpec:
                 f"bad fault spec {text!r}: expected kind@STEP or "
                 "kind@STEPxCOUNT with integer STEP/COUNT"
             )
-    return FaultSpec(kind=kind, step=step, count=count, once=once)
+    return FaultSpec(kind=kind, step=step, count=count, once=once,
+                     replica=replica)
 
 
 class FaultInjector:
@@ -105,6 +131,7 @@ class FaultInjector:
         self.spec = spec
         self.healed = False
         self._sigterm_fired = False
+        self._crash_fired = False
 
     # -- training: data poisoning -----------------------------------------
 
@@ -160,6 +187,35 @@ class FaultInjector:
         (``stall`` faults; ``spec.step`` holds the target id)."""
         return self.spec.kind == "stall" and not self.healed \
             and request_id == self.spec.step
+
+    @property
+    def crash_pending(self) -> bool:
+        """An armed replica_crash that has not fired yet — the fleet
+        controller checks this at run end: a crash tick beyond the
+        run's horizon must FAIL the run loudly, never report a clean
+        pass that exercised nothing."""
+        return self.spec.kind == "replica_crash" and not self._crash_fired
+
+    def rearm(self) -> None:
+        """Re-arm the one-shot replica_crash latch for a fresh run (the
+        fleet controller's ``reset`` — a replayed scenario must crash
+        again at the same tick). Trainer-side latches (sigterm, healed
+        data faults) are NOT touched: their one-shot semantics span
+        resume cycles by design."""
+        self._crash_fired = False
+
+    def crashes_replica(self, tick: int) -> int | None:
+        """The fleet-replica id to kill once the GLOBAL clock reaches
+        ``spec.step`` (``replica_crash`` faults fire ONCE), else None.
+        The controller (``serve.controller``) consults this every
+        global tick — delivery is deterministic on the tick clock, so
+        a seeded crash scenario replays exactly."""
+        if self.spec.kind != "replica_crash" or self._crash_fired:
+            return None
+        if tick >= self.spec.step:
+            self._crash_fired = True
+            return self.spec.replica
+        return None
 
 
 # -- checkpoint chaos ---------------------------------------------------------

@@ -12,6 +12,10 @@ batching — the inference half of the sharded-mesh story.
 - ``serve.router``    — the multi-tenant front door: SLO-aware routing
   of classed traffic over N scheduler/engine replicas (prefix-affinity
   placement, priority shedding, per-class SLO accounting)
+- ``serve.controller`` — the self-healing fleet controller: SLO/
+  pressure-driven autoscaling, drain-before-removal, replica-crash
+  recovery and cross-replica request preemption on the router's
+  deterministic global clock
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
 
@@ -24,6 +28,11 @@ Quickstart (also ``python -m ddl_tpu serve --help``)::
     ])
 """
 
+from .controller import (  # noqa: F401
+    AutoscaleConfig,
+    FleetController,
+    parse_autoscale_spec,
+)
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .prefix import PrefixIndex  # noqa: F401
 from .router import (  # noqa: F401
@@ -36,6 +45,7 @@ from .router import (  # noqa: F401
 )
 from .scheduler import (  # noqa: F401
     Completion,
+    PreemptedRequest,
     Pressure,
     Request,
     Scheduler,
@@ -45,9 +55,12 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscaleConfig",
     "ClassSpec",
     "Completion",
+    "FleetController",
     "InferenceEngine",
+    "PreemptedRequest",
     "PrefixIndex",
     "Pressure",
     "Request",
@@ -58,6 +71,7 @@ __all__ = [
     "ServeConfig",
     "ServeStats",
     "derive_request_slo",
+    "parse_autoscale_spec",
     "parse_slo_spec",
     "parse_traffic_spec",
     "request_slo_samples",

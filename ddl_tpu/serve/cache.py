@@ -218,6 +218,35 @@ def copy_page(
     )
 
 
+def write_page(
+    pool: PagedKVCache,
+    *,
+    dst_page: jax.Array,
+    k_rows: jax.Array,
+    v_rows: jax.Array,
+    pos_rows: jax.Array,
+) -> PagedKVCache:
+    """Overwrite ``dst_page`` of the pool with caller-supplied rows (K/V
+    of every layer + positions) — the receive half of the cross-replica
+    KV hand-off (``serve.controller`` preemption): a preempted request's
+    pages, fetched host-side from the SOURCE replica's pool
+    (``engine.dump_slot_pages``), land bit-for-bit in freshly mapped
+    pages of the destination's, so the resumed request's attend view is
+    the source's to the bit. ``k_rows``/``v_rows`` are ``[L, 1, page, H,
+    D]`` and ``pos_rows`` ``[1, page]`` — a whole page, including any
+    ``PAD_POS`` tail, so the free-list invariant survives the write. The
+    page id is traced — ONE compiled program covers every transfer;
+    head-dim tp sharding is row-local (the rows arrive sharded the same
+    way), no collective needed."""
+    return PagedKVCache(
+        k=lax.dynamic_update_slice_in_dim(pool.k, k_rows, dst_page, axis=1),
+        v=lax.dynamic_update_slice_in_dim(pool.v, v_rows, dst_page, axis=1),
+        pos=lax.dynamic_update_slice_in_dim(
+            pool.pos, pos_rows, dst_page, axis=0
+        ),
+    )
+
+
 class PagePool:
     """Host-side page allocator for the paged pool: free list, per-page
     refcounts, and admission RESERVATIONS — the whole "enough free

@@ -1,0 +1,580 @@
+"""Self-healing serve fleet: the SLO-driven controller over the
+router's replicas (ISSUE 13 tentpole; ROADMAP item 4 closed).
+
+PR 8's router owns N STATIC replicas and PRs 10/11 made every input
+live — ``Scheduler.pressure()``, per-class burn rates,
+``slo_alerts_total``, pages-free and goodput gauges — but nothing acted
+on them: overload was shed at the door, an idle replica burned capacity
+forever, and a dead replica took its requests with it. This module
+closes the loop. The controller is ticked on the router's GLOBAL clock
+and every decision reads only deterministic host state (pressure
+counts, tick counters, the burn-rate monitors' tick windows), so every
+scale / drain / preempt / crash event is a replayable seeded scenario —
+two fresh runs fire at identical ticks (pinned in tests/test_fleet.py).
+
+Four closed loops:
+
+- **Scale out** (``_maybe_scale_out``): when mean outstanding work per
+  live replica stays at or above ``backlog_per_replica`` for
+  ``sustain_ticks`` consecutive ticks — or any watched SLO rule's fast
+  AND slow burns cross its threshold (the Google-SRE condition PR 10's
+  monitors compute, finally driving a controller instead of a
+  dashboard) — a replica spins up: ``InferenceEngine(placed_params=)``
+  shares the fleet's one placed param copy (no second placement), and
+  warmup compiles its program ladder OFF the timed path when the router
+  was warmed. While the fleet can still grow, the router DEFERS its
+  door shed — the same traffic that fires ``bulk_shed`` on a static
+  fleet instead triggers scale-out, and the alert never fires.
+- **Scale in via drain** (``_maybe_scale_in`` → ``_finish_drains``): a
+  replica idle for ``idle_ticks`` consecutive ticks (fleet above
+  ``min_replicas``) begins DRAINING — placement skips it, its occupants
+  finish, and only when it reads idle is it collected, released (the
+  hardened ``Scheduler.release`` returns its pool byte-whole,
+  reservations included) and removed. Draining replicas still tick.
+- **Crash recovery** (``_maybe_crash``): ``--inject-fault
+  replica_crash@T:R`` kills replica R at global tick T — engine and
+  page pool discarded wholesale, no graceful release (the device is
+  gone). The driver-side ledger survives: finished completions keep
+  their status, in-flight and queued requests re-queue at the door with
+  ``Completion.status="requeued"`` placeholders (idempotent — the
+  final completion overwrites exactly once, and per-class tallies count
+  each request once), and the fleet heals: below ``min_replicas`` a
+  replacement spawns the same tick. Re-served requests produce the SAME
+  tokens — sampling keys fold in only (seed, request_id, token_index).
+- **Cross-replica preemption** (``_maybe_preempt``): a waiting request
+  whose class is at least ``preempt_priority_gap`` more protected than
+  an ACTIVE occupant of the replica it queues at, waiting
+  ``preempt_wait_ticks`` ticks, evicts that replica's lowest-priority
+  occupant mid-decode — its held KV pages serialize host-side
+  (``Scheduler.preempt``) and it resumes on the least-loaded replica
+  with a free slot and pages (``Scheduler.adopt``), BIT-IDENTICAL to an
+  unpreempted run (pages move as bits; the sampling key ignores slots,
+  replicas and arrival — the repo's strongest pin style, pinned via
+  per-step logits at tp=1 AND tp=2 in tests/test_fleet.py). Preemption
+  needs the paged KV layout: slot-independent refcounted pages are what
+  make the hand-off a serialize/deserialize, not a recompute.
+
+Telemetry: ``scale_events_total{kind=}``, ``preemptions_total``,
+``fleet_requeues_total``, ``fleet_crashes_total`` counters and
+``fleet_replicas_active`` / ``fleet_replicas_draining`` /
+``fleet_last_scale_tick`` gauges on the router registry; trace events
+``scale_out`` / ``scale_in`` / ``drain`` / ``preempt`` / ``resume`` /
+``replica_crash`` / ``requeue`` render under ``cat=incident`` in the
+Chrome converter with flow chains (a preempt flows to its resume to the
+request's completion) and in the ``obs.analyze`` fleet-incident table.
+``/healthz`` carries the compact fleet digest
+(``obs.goodput.fleet_summary``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .scheduler import Completion
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Fleet policy. ``max_replicas`` bounds the fleet (every replica is
+    a full engine — compiled programs + KV pool); ``min_replicas`` is
+    the floor scale-in and crash healing maintain. Scale-out triggers
+    on SUSTAINED pressure (``backlog_per_replica`` mean outstanding work
+    per live replica for ``sustain_ticks`` ticks) or on any
+    ``burn_rules``-named SLO rule alerting (fast AND slow windows hot —
+    the monitor's own condition). ``idle_ticks`` consecutive idle ticks
+    drain a surplus replica. Preemption (``preempt``) moves a
+    lower-priority ACTIVE occupant when a class at least
+    ``preempt_priority_gap`` more protected has waited
+    ``preempt_wait_ticks`` ticks at its replica and another replica has
+    a free slot + pages."""
+
+    max_replicas: int
+    min_replicas: int = 1
+    backlog_per_replica: float = 2.0
+    sustain_ticks: int = 2
+    idle_ticks: int = 8
+    preempt: bool = True
+    preempt_wait_ticks: int = 2
+    preempt_priority_gap: int = 1
+    burn_rules: tuple[str, ...] = ()
+    # While the fleet can still grow, the router's door shed defers to
+    # scale-out (the ISSUE 13 acting-on-load contract). The TRADE: if
+    # the scale thresholds are conservative enough that the fleet never
+    # actually grows, class-margin door shedding stays off the whole
+    # run and only the per-replica (class-blind) shed bounds admitted
+    # overload. Operators with deliberately high thresholds should set
+    # defer_door_shed=False (spec key ``defer=0``) to keep the static
+    # door-shed behavior alongside the controller.
+    defer_door_shed: bool = True
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) below min_replicas "
+                f"({self.min_replicas})"
+            )
+        if self.backlog_per_replica <= 0:
+            raise ValueError(
+                f"backlog_per_replica must be > 0, got "
+                f"{self.backlog_per_replica}"
+            )
+        for name in ("sustain_ticks", "idle_ticks", "preempt_wait_ticks",
+                     "preempt_priority_gap"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"{name} must be >= 1, got {getattr(self, name)}"
+                )
+
+
+def parse_autoscale_spec(spec: str, *, max_replicas: int | None = None,
+                         replicas: int = 1) -> AutoscaleConfig:
+    """``--autoscale`` grammar -> :class:`AutoscaleConfig`. Comma-joined
+    ``key=val`` with keys ``max`` (cap; ``--max-replicas`` overrides),
+    ``min``, ``backlog`` (mean outstanding per replica), ``sustain``
+    (ticks), ``idle`` (ticks before drain), ``preempt`` (0/1), ``wait``
+    (preempt wait ticks), ``gap`` (priority gap), ``burn`` ('|'-joined
+    SLO rule names to watch). Example::
+
+        backlog=3,sustain=2,idle=6,burn=bulk_shed
+    """
+    key_map = {
+        "max": ("max_replicas", int),
+        "min": ("min_replicas", int),
+        "backlog": ("backlog_per_replica", float),
+        "sustain": ("sustain_ticks", int),
+        "idle": ("idle_ticks", int),
+        "preempt": ("preempt", lambda v: bool(int(v))),
+        "wait": ("preempt_wait_ticks", int),
+        "gap": ("preempt_priority_gap", int),
+        "burn": ("burn_rules", lambda v: tuple(
+            s.strip() for s in v.split("|") if s.strip()
+        )),
+        "defer": ("defer_door_shed", lambda v: bool(int(v))),
+    }
+    kw: dict = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, val = part.partition("=")
+        key = key.strip()
+        if not eq:
+            raise ValueError(
+                f"autoscale segment {part!r} needs key=val"
+            )
+        if key not in key_map:
+            raise ValueError(
+                f"unknown autoscale key {key!r} "
+                f"(valid: {', '.join(key_map)})"
+            )
+        dest, conv = key_map[key]
+        try:
+            kw[dest] = conv(val)
+        except ValueError as e:
+            raise ValueError(
+                f"autoscale segment {part!r}: bad value ({e})"
+            )
+    if max_replicas is not None:
+        kw["max_replicas"] = max_replicas
+    if "max_replicas" not in kw:
+        raise ValueError(
+            "autoscale needs a fleet cap: pass --max-replicas N or a "
+            "max=N key"
+        )
+    kw.setdefault("min_replicas", min(replicas, kw["max_replicas"]))
+    return AutoscaleConfig(**kw)
+
+
+class FleetController:
+    """The deterministic fleet controller (module docstring). Bound to
+    exactly one :class:`serve.router.Router` (its ctor calls
+    :meth:`bind`); the router's run loop calls :meth:`begin_tick`
+    before routing, :meth:`after_route` after, and :meth:`finish` when
+    the stream drains. ``events`` records every action as
+    ``(tick, kind, detail)`` — the tick-reproducibility pin surface."""
+
+    def __init__(self, config: AutoscaleConfig, *, injector=None):
+        self.config = config
+        self.injector = injector
+        self.router = None
+        self._sustain = 0
+        self._idle: dict[int, int] = {}
+        self._wait_since: dict[int, int] = {}
+        self._moved: set[int] = set()
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.drains = 0
+        self.preemptions = 0
+        self.requeues = 0
+        self.crashes = 0
+        self.last_scale_tick = -1
+        self.events: list[tuple] = []
+
+    def bind(self, router) -> None:
+        if self.router is not None and self.router is not router:
+            raise ValueError(
+                "this FleetController is already bound to another router"
+            )
+        if router.config.replicas > self.config.max_replicas:
+            raise ValueError(
+                f"router starts with {router.config.replicas} replicas, "
+                f"above max_replicas {self.config.max_replicas}"
+            )
+        # burn= rules are validated HERE, not mid-run: a typo'd rule
+        # name (or burn rules with no monitor to read) must be a
+        # config error at bind time, never a tick-15 traceback or a
+        # silently-never-firing trigger.
+        if self.config.burn_rules:
+            if router.slo_monitor is None:
+                raise ValueError(
+                    "autoscale burn rules "
+                    f"{list(self.config.burn_rules)} need an SLO "
+                    "monitor on the router (--slo-rules) — without one "
+                    "the burn trigger could never fire"
+                )
+            known = {r.name for r in router.slo_monitor.rules}
+            bad = [n for n in self.config.burn_rules if n not in known]
+            if bad:
+                raise ValueError(
+                    f"autoscale burn rules {bad} are not among the "
+                    f"monitor's rules ({sorted(known)})"
+                )
+        self.router = router
+
+    def reset(self) -> None:
+        """Clear per-run state AND the cumulative event ledger (the
+        router's ``reset`` calls this): a fresh run from the same seed
+        and the same fleet topology replays the same events, and
+        ``summary()`` reports that run alone. Fleet TOPOLOGY is the one
+        thing reset cannot restore — replicas removed or crashed in a
+        previous run stay gone (their device state is gone)."""
+        self._sustain = 0
+        self._idle.clear()
+        self._wait_since.clear()
+        self._moved.clear()
+        self.scale_outs = self.scale_ins = self.drains = 0
+        self.preemptions = self.requeues = self.crashes = 0
+        self.last_scale_tick = -1
+        self.events.clear()
+        if self.injector is not None:
+            self.injector.rearm()
+
+    # -- the per-tick hooks (called by Router.run) ---------------------------
+
+    def begin_tick(self, t: int, done: dict) -> None:
+        """Pre-routing phase: deliver any injected crash, heal below the
+        floor, and finalize drains whose replica has gone idle."""
+        self._maybe_crash(t, done)
+        self._heal(t)
+        self._finish_drains(t, done)
+        self._publish()
+
+    def after_route(self, t: int) -> None:
+        """Post-routing phase: preempt, then scale on pressure/burns,
+        then begin drains — all from this tick's routed state."""
+        self._maybe_preempt(t)
+        self._maybe_scale_out(t)
+        self._maybe_scale_in(t)
+        self._publish()
+
+    def finish(self, t: int, done: dict) -> None:
+        """Stream drained: complete the scale-in story — finalize any
+        drain already in flight, then drain-and-remove surplus ROUTABLE
+        replicas down to the floor (every live replica is idle by the
+        loop's exit condition). Counting routable replicas — never the
+        already-draining ones — is what keeps a drain from being begun
+        twice and the fleet from dipping below ``min_replicas``. An
+        armed replica_crash that never fired (trigger tick beyond the
+        run) fails the run LOUDLY — a chaos run that exercised nothing
+        must not report a clean pass."""
+        if self.injector is not None and self.injector.crash_pending:
+            raise RuntimeError(
+                f"replica_crash@{self.injector.spec.step} never fired: "
+                f"the run ended at tick {t} — move the trigger inside "
+                "the traffic horizon"
+            )
+        r = self.router
+        self._finish_drains(t, done)
+        while True:
+            live = self._routable()
+            if len(live) <= self.config.min_replicas:
+                break
+            k = max(live)
+            if not r.scheds[k].idle:
+                break
+            self._begin_drain(t, k)
+            self._finish_drains(t, done)
+        self._publish()
+
+    def can_scale_out(self) -> bool:
+        """True while the fleet can still grow."""
+        return len(self._live()) < self.config.max_replicas
+
+    def defers_door_shed(self) -> bool:
+        """True while the router should defer its door shed to
+        scale-out (capacity is coming; acting on load beats shedding
+        it). At max scale — or with ``defer_door_shed=False`` (the
+        conservative-thresholds opt-out, config docstring) — the door
+        shed is the backstop again."""
+        return self.config.defer_door_shed and self.can_scale_out()
+
+    # -- state probes -------------------------------------------------------
+
+    def _live(self) -> list[int]:
+        return self.router.live_ids()
+
+    def _routable(self) -> list[int]:
+        return self.router.live_ids(routable=True)
+
+    def _event(self, t: int, kind: str, **detail) -> None:
+        self.events.append((t, kind, tuple(sorted(detail.items()))))
+        if self.router.tracer:
+            self.router.tracer.event(kind, tick=t, **detail)
+
+    def _count(self, name: str, **labels) -> None:
+        reg = self.router.registry
+        if reg is not None:
+            reg.counter(name).inc(**labels)
+
+    def _publish(self) -> None:
+        reg = self.router.registry
+        if reg is None:
+            return
+        reg.gauge("fleet_replicas_active").set(len(self._routable()))
+        reg.gauge("fleet_replicas_draining").set(len(self.router.draining))
+        reg.gauge("fleet_last_scale_tick").set(self.last_scale_tick)
+
+    # -- crash recovery -----------------------------------------------------
+
+    def _maybe_crash(self, t: int, done: dict) -> None:
+        if self.injector is None:
+            return
+        k = self.injector.crashes_replica(t)
+        if k is None:
+            return
+        r = self.router
+        if k >= len(r.scheds):
+            # A victim the fleet never created is a scenario error —
+            # silently spending the one-shot latch would fake a passing
+            # chaos run (the cli.py guard's rationale).
+            raise ValueError(
+                f"replica_crash targets replica {k} at tick {t} but the "
+                f"fleet has only ever had {len(r.scheds)} replicas"
+            )
+        if r.scheds[k] is None:
+            # Legitimately gone already (drained or double-crashed) —
+            # record the miss instead of killing nothing silently.
+            self._event(t, "replica_crash", replica=k, missed=True)
+            return
+        cdone, inflight, queued = r.scheds[k].abandon()
+        done.update(cdone)
+        inflight_ids = {q.id for q in inflight}
+        for req in inflight + queued:
+            # Idempotent placeholder: the final completion (from the
+            # re-run) overwrites it exactly once at merge time; a
+            # double crash re-writing "requeued" is harmless. A request
+            # that was ADMITTED before the crash re-routes shed-exempt:
+            # its admission decision is never re-made (a crash must not
+            # convert served work into a refusal); queued-at-crash
+            # requests face re-admission like any arrival.
+            done[req.id] = Completion(
+                id=req.id,
+                prompt_len=int(len(req.prompt)),
+                tokens=[], admitted_step=-1, finished_step=t,
+                status="requeued",
+            )
+            r.requeue(req, shed_exempt=req.id in inflight_ids)
+            self.requeues += 1
+            self._event(t, "requeue", req=int(req.id), replica=k)
+            self._count("fleet_requeues_total")
+        r.kill_replica(k)
+        self.crashes += 1
+        self._idle.pop(k, None)
+        self._event(t, "replica_crash", replica=k,
+                    inflight=len(inflight), queued=len(queued))
+        self._count("fleet_crashes_total")
+
+    def _heal(self, t: int) -> None:
+        while len(self._live()) < self.config.min_replicas:
+            k = self.router.add_replica()
+            self.scale_outs += 1
+            self.last_scale_tick = t
+            self._event(t, "scale_out", replica=k, reason="heal")
+            self._count("scale_events_total", kind="scale_out")
+
+    # -- scale out ----------------------------------------------------------
+
+    def _maybe_scale_out(self, t: int) -> None:
+        live = self._routable()
+        if not live:
+            return
+        backlog = 0
+        for k in live:
+            p = self.router.scheds[k].pressure()
+            backlog += p.occupied_slots + p.pending_total
+        if backlog / len(live) >= self.config.backlog_per_replica:
+            self._sustain += 1
+        else:
+            self._sustain = 0
+        burn_hot = False
+        mon = self.router.slo_monitor
+        if mon is not None:
+            # Rule names were validated against the monitor at bind().
+            for name in self.config.burn_rules:
+                rule = next(rr for rr in mon.rules if rr.name == name)
+                if (mon.burn_rate(name, "fast") >= rule.threshold
+                        and mon.burn_rate(name, "slow") >= rule.threshold):
+                    burn_hot = True
+                    break
+        if not (self._sustain >= self.config.sustain_ticks or burn_hot):
+            return
+        if len(self._live()) >= self.config.max_replicas:
+            return
+        k = self.router.add_replica()
+        self.scale_outs += 1
+        self.last_scale_tick = t
+        self._sustain = 0
+        self._event(t, "scale_out", replica=k,
+                    reason="burn" if burn_hot else "pressure")
+        self._count("scale_events_total", kind="scale_out")
+
+    # -- scale in / drain ---------------------------------------------------
+
+    def _maybe_scale_in(self, t: int) -> None:
+        live = self._routable()
+        for k in list(self._idle):
+            if k not in live:
+                del self._idle[k]
+        for k in live:
+            self._idle[k] = (self._idle.get(k, 0) + 1
+                             if self.router.scheds[k].idle else 0)
+        if len(live) <= self.config.min_replicas:
+            return
+        ripe = [k for k in live
+                if self._idle.get(k, 0) >= self.config.idle_ticks]
+        if not ripe:
+            return
+        # Highest id first: the most-recently scaled-out replica goes
+        # back first (LIFO capacity), one drain per tick.
+        self._begin_drain(t, max(ripe))
+
+    def _begin_drain(self, t: int, k: int) -> None:
+        self.router.draining.add(k)
+        self._idle.pop(k, None)
+        self.drains += 1
+        self._event(t, "drain", replica=k)
+        self._count("scale_events_total", kind="drain")
+
+    def _finish_drains(self, t: int, done: dict) -> None:
+        r = self.router
+        for k in sorted(r.draining):
+            sched = r.scheds[k]
+            if sched is None or not sched.idle:
+                continue  # occupants still finishing — keep ticking it
+            r.remove_replica(k, done)
+            self.scale_ins += 1
+            self.last_scale_tick = t
+            self._event(t, "scale_in", replica=k)
+            self._count("scale_events_total", kind="scale_in")
+
+    # -- cross-replica preemption -------------------------------------------
+
+    def _maybe_preempt(self, t: int) -> None:
+        if not self.config.preempt:
+            return
+        r = self.router
+        live = self._routable()
+        if not live or not r.engines[live[0]].paged:
+            # Preemption is a page hand-off: the contiguous layout has
+            # no slot-independent pages to move (config docstring).
+            return
+        # Age the waiting ledger: first-seen tick per HEAD waiter. Only
+        # the FIFO head can trigger a preemption — admission is
+        # strictly FIFO, so a freed slot goes to the head; firing for a
+        # deeper waiter would migrate pages without serving it.
+        waiting_now: dict[int, tuple[int, object]] = {}
+        for k in live:
+            heads = r.scheds[k].waiting_eligible_requests()
+            if heads:
+                req = heads[0]
+                waiting_now[req.id] = (k, req)
+                self._wait_since.setdefault(req.id, t)
+        for rid in list(self._wait_since):
+            if rid not in waiting_now:
+                del self._wait_since[rid]
+        for rid, (src, req) in sorted(waiting_now.items()):
+            if t - self._wait_since[rid] < self.config.preempt_wait_ticks:
+                continue
+            wait_pri = r.priority_of(req)
+            # Victim: the source replica's lowest-priority ACTIVE
+            # occupant, at least `gap` less protected than the waiter.
+            # A request moves at most ONCE (self._moved): re-evicting a
+            # freshly adopted occupant would ping-pong its growing
+            # pages between replicas without serving anyone sooner.
+            victims = [
+                (r.priority_of(occ), s, occ)
+                for s, occ, active in r.scheds[src].occupant_requests()
+                if active
+                and occ.id not in self._moved
+                and r.priority_of(occ) - wait_pri
+                >= self.config.preempt_priority_gap
+            ]
+            if not victims:
+                continue
+            _, _, victim = max(victims, key=lambda v: (v[0], v[1]))
+            need = r.engines[src].pages_needed(
+                int(len(victim.prompt)) + victim.max_new_tokens
+            )
+            # Destination: a free slot + pages AND no waiters of its
+            # own — adopting into a replica whose queue is non-empty
+            # would queue-jump that replica's FIFO.
+            dests = []
+            for k in live:
+                if k == src:
+                    continue
+                p = r.scheds[k].pressure()
+                # pending_total, not waiting_eligible: a freshly
+                # scaled-out replica's local clock lags the router's,
+                # so routed-but-not-yet-locally-eligible arrivals must
+                # still count as "this replica has its own queue".
+                if (p.occupied_slots < r.config.serve.slots
+                        and p.pending_total == 0
+                        and p.pages_available >= need):
+                    dests.append((p.occupied_slots + p.pending_total,
+                                  -p.pages_available, k))
+            if not dests:
+                continue
+            dst = min(dests)[2]
+            pre = r.scheds[src].preempt(victim.id)
+            r.scheds[dst].adopt(pre)
+            r.note_move(victim.id, dst)
+            self._moved.add(victim.id)
+            self.preemptions += 1
+            self._event(t, "preempt_move", req=int(victim.id),
+                        src=src, dst=dst)
+            self._count("preemptions_total")
+            return  # one preemption per tick — deterministic and gentle
+
+    # -- reporting ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """JSON-able digest (the CLI / bench surface)."""
+        return {
+            "max_replicas": self.config.max_replicas,
+            "min_replicas": self.config.min_replicas,
+            "scale_outs": self.scale_outs,
+            "scale_ins": self.scale_ins,
+            "drains": self.drains,
+            "preemptions": self.preemptions,
+            "requeues": self.requeues,
+            "crashes": self.crashes,
+            "last_scale_tick": self.last_scale_tick,
+            "events": [
+                {"tick": t, "kind": kind, **dict(detail)}
+                for t, kind, detail in self.events
+            ],
+        }

@@ -102,6 +102,7 @@ from .cache import (
     host_cache,
     host_paged_cache,
     paged_cache_specs,
+    write_page,
 )
 from .prefix import PrefixIndex
 
@@ -302,6 +303,7 @@ class InferenceEngine:
         self._copy_in = None  # pool slot -> cache slot (prefix hit)
         self._copy_out = None  # cache slot -> pool slot (registration)
         self._copy_page_fn = None  # paged CoW: partial tail page
+        self._write_page_fn = None  # paged: cross-replica page hand-off
         self._reset_pages_fn = None  # paged: PAD_POS freed pages' pos
         if self.paged:
             self._pcspecs = paged_cache_specs(tp)
@@ -753,6 +755,81 @@ class InferenceEngine:
         )
         self._note_compile("prefix_copy", 0)
         return self._copy_page_fn
+
+    def _write_page(self):
+        """Compiled whole-page write (``serve.cache.write_page``): the
+        receive half of cross-replica preemption (``serve.controller``).
+        Page id traced — one program total; the K/V rows arrive with the
+        pool's own head-dim tp sharding."""
+        if self._write_page_fn is not None:
+            return self._write_page_fn
+
+        def shard_body(pool, dst_page, k_rows, v_rows, pos_rows):
+            return write_page(pool, dst_page=dst_page, k_rows=k_rows,
+                              v_rows=v_rows, pos_rows=pos_rows)
+
+        shard = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=(self._pcspecs, jax.sharding.PartitionSpec(),
+                      self._pcspecs.k, self._pcspecs.v, self._pcspecs.pos),
+            out_specs=self._pcspecs,
+            check_vma=False,
+        )
+        self._write_page_fn = jax.jit(
+            shard, donate_argnums=donation_for(self.mesh, 0)
+        )
+        self._note_compile("page_write", 0)
+        return self._write_page_fn
+
+    def dump_slot_pages(self, slot: int):
+        """Serialize ``slot``'s resident pages host-side — the send half
+        of cross-replica preemption: ``(k, v, pos)`` numpy arrays of
+        shape ``[L, n, page, H, D]`` / ``[n, page]`` where ``n`` is the
+        slot's mapped page count, in BLOCK-TABLE order (the order the
+        gathered attend view reconstructs), assembled across tp shards
+        by ``device_get``. A host round-trip moves bits, not values —
+        the destination's attend view is bit-identical by
+        construction."""
+        if not self.paged:
+            raise RuntimeError(
+                "dump_slot_pages needs the paged KV layout (page_size > "
+                "0) — the contiguous ring has no slot-independent pages "
+                "to hand off"
+            )
+        n = int(self.table_len[slot])
+        pages = jnp.asarray(self.tables[slot, :n], jnp.int32)
+        k = np.asarray(jax.device_get(jnp.take(self.cache.k, pages, axis=1)))
+        v = np.asarray(jax.device_get(jnp.take(self.cache.v, pages, axis=1)))
+        pos = np.asarray(jax.device_get(jnp.take(self.cache.pos, pages,
+                                                 axis=0)))
+        return k, v, pos
+
+    def load_slot_pages(self, slot: int, k, v, pos) -> list[int]:
+        """Make serialized page contents resident in ``slot``: map one
+        FRESH page per source page (consuming the slot's admission
+        reservation, exactly like prefill growth) and overwrite it whole
+        with the serialized rows. The freshly mapped page was fully
+        ``PAD_POS`` (free-list invariant) and the written ``pos`` rows
+        carry the source's own ``PAD_POS`` tail, so nothing stale is
+        ever attendable. Returns the mapped page ids (table order)."""
+        if not self.paged:
+            raise RuntimeError(
+                "load_slot_pages needs the paged KV layout (page_size > 0)"
+            )
+        n = int(k.shape[1])
+        fn = self._write_page()
+        mapped = []
+        for i in range(n):
+            page = self._map_page(slot)
+            kk = multihost.put(self.mesh, self._pcspecs.k,
+                               np.ascontiguousarray(k[:, i:i + 1]))
+            vv = multihost.put(self.mesh, self._pcspecs.v,
+                               np.ascontiguousarray(v[:, i:i + 1]))
+            pp = multihost.put(self.mesh, self._pcspecs.pos,
+                               np.ascontiguousarray(pos[i:i + 1]))
+            self.cache = fn(self.cache, jnp.int32(page), kk, vv, pp)
+            mapped.append(page)
+        return mapped
 
     def decode_page_bucket(self, pages: int) -> int:
         """The page-count bucket ladder: smallest power of two >=

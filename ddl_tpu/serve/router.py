@@ -43,6 +43,19 @@ replica order. An idle scheduler tick makes no device calls, so a
 seed-reproducible — same tokens, same placements — as long as
 wall-clock deadlines are off (deadlines evict on real time, exactly as
 in the bare scheduler). Pinned in tests/test_router.py.
+
+**Fleet dynamics** (ISSUE 13): with a ``serve.controller``
+``FleetController`` attached, the replica set becomes DYNAMIC — the
+``engines``/``scheds`` lists grow on scale-out (``add_replica``: shared
+placed params, warmed off the timed path, armed mid-run), hold ``None``
+where a replica was removed (graceful ``remove_replica`` after a drain)
+or crashed (``kill_replica`` — discarded wholesale), and a DRAINING
+replica keeps ticking but receives no routed arrivals. The door queue
+re-routes crash-orphaned requests, and while the fleet can still grow
+the door shed DEFERS to scale-out. Without a controller every new path
+is dormant: the candidate list is all replicas, the door stays empty,
+and the run loop is byte-identical to the static router (the
+transparency pin still holds).
 """
 
 from __future__ import annotations
@@ -148,7 +161,11 @@ class ClassReport:
 class RouterStats:
     """One router run's accounting: per-class SLO reports, placement
     ledger (request id -> replica), policy counters, and each replica's
-    own ``ServeStats``."""
+    own ``ServeStats``. ``replica`` has one entry per replica id EVER
+    created this run (the fleet controller may grow the list); a
+    crashed replica's entry is ``None`` — its device-side stats died
+    with it. ``fleet`` is the controller's digest (None on a static
+    fleet)."""
 
     per_class: dict[str, ClassReport]
     placements: dict[int, int]
@@ -156,15 +173,16 @@ class RouterStats:
     load_placements: int
     router_sheds: int
     ticks: int
-    replica: list[ServeStats]
+    replica: list[ServeStats | None]
+    fleet: dict | None = None
 
     @property
     def prefix_lookups(self) -> int:
-        return sum(s.prefix_lookups for s in self.replica)
+        return sum(s.prefix_lookups for s in self.replica if s is not None)
 
     @property
     def prefix_hits(self) -> int:
-        return sum(s.prefix_hits for s in self.replica)
+        return sum(s.prefix_hits for s in self.replica if s is not None)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -197,6 +215,7 @@ class RouterStats:
             "router_sheds": self.router_sheds,
             "prefix_hit_rate": round(self.prefix_hit_rate, 3),
             "ticks": self.ticks,
+            **({"fleet": self.fleet} if self.fleet is not None else {}),
         }
 
 
@@ -218,7 +237,7 @@ class Router:
     def __init__(self, config: RouterConfig, params=None, *,
                  registry=None, tracer=None, injector=None,
                  slo_monitor=None, peak_flops: float | None = None,
-                 anomaly_detector=None):
+                 anomaly_detector=None, controller=None):
         if config.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1, got {config.replicas}"
@@ -278,7 +297,9 @@ class Router:
             params = transformer.init_lm_params(
                 jax.random.PRNGKey(config.serve.seed), config.serve.spec
             )
-        self.engines: list[InferenceEngine] = []
+        self._injector = injector
+        self._peak_flops = peak_flops
+        self.engines: list[InferenceEngine | None] = []
         for k in range(config.replicas):
             # One checkpoint, one placed copy: replica 0 places the
             # host tree; every other replica SHARES its device arrays
@@ -290,22 +311,20 @@ class Router:
                        config.serve,
                        placed_params=self.engines[0].params))
             self.engines.append(eng)
+        # The fleet's ONE placed param tree, held by the driver itself:
+        # scale-out and crash healing build replacement replicas from
+        # it even after replica 0 is gone (ISSUE 13).
+        self._placed_params = self.engines[0].params
         self.replica_registries = None
-        regs = [None] * config.replicas
+        regs: list = [None] * config.replicas
         if registry is not None:
             from ..obs import MetricRegistry
 
             self.replica_registries = [MetricRegistry()
                                        for _ in range(config.replicas)]
             regs = self.replica_registries
-        self.scheds = [
-            Scheduler(
-                eng, eos_id=config.eos_id, tracer=self.tracer,
-                registry=regs[k], shed_threshold=config.shed_threshold,
-                ttft_deadline_s=config.ttft_deadline_s,
-                deadline_s=config.deadline_s, injector=injector,
-                peak_flops=peak_flops,
-            )
+        self.scheds: list[Scheduler | None] = [
+            self._make_scheduler(eng, regs[k])
             for k, eng in enumerate(self.engines)
         ]
         # Live SLO monitor (ISSUE 10): advanced once per GLOBAL tick in
@@ -330,6 +349,168 @@ class Router:
         # detectors off.
         self.anomaly = anomaly_detector
         self._sticky: dict[bytes, int] = {}
+        # Fleet state (ISSUE 13): a DRAINING replica stops receiving
+        # routed arrivals but keeps ticking until its occupants finish;
+        # the door queue holds requests awaiting (re-)routing — crash
+        # requeues, and any arrival landing while no replica is
+        # routable. Both empty forever on a static fleet.
+        self.draining: set[int] = set()
+        # Door entries are (request, first): `first` is False once the
+        # request has been COUNTED as an arrival (a crash requeue, or a
+        # retry of an arrival that found no routable replica) — the
+        # attempts counter moves once per request, while the shed
+        # decision re-runs on every pass unless the request is
+        # shed_exempt (already admitted before a crash).
+        self._door: list[tuple[Request, bool]] = []
+        self._warm_items = None
+        self._armed = False
+        self._run_counters: dict | None = None
+        self._collected: dict[int, ServeStats] = {}
+        self._requeue_marks: dict[int, int] = {}
+        self._rec_start = 0
+        self.controller = controller
+        if controller is not None:
+            controller.bind(self)
+
+    # -- fleet surgery (ISSUE 13; driven by serve.controller) ---------------
+
+    def _make_scheduler(self, eng: InferenceEngine, reg) -> Scheduler:
+        cfg = self.config
+        return Scheduler(
+            eng, eos_id=cfg.eos_id, tracer=self.tracer,
+            registry=reg, shed_threshold=cfg.shed_threshold,
+            ttft_deadline_s=cfg.ttft_deadline_s,
+            deadline_s=cfg.deadline_s, injector=self._injector,
+            peak_flops=self._peak_flops,
+        )
+
+    def live_ids(self, *, routable: bool = False) -> list[int]:
+        """Replica ids with a live scheduler; ``routable=True``
+        additionally excludes draining replicas (they tick, they do not
+        receive)."""
+        return [k for k, s in enumerate(self.scheds)
+                if s is not None
+                and (not routable or k not in self.draining)]
+
+    def priority_of(self, req: Request) -> int:
+        """The request's class priority (0 = most protected) — the
+        controller's preemption ordering."""
+        return self.classes[req.traffic_class].priority
+
+    def add_replica(self) -> int:
+        """Scale out: a new replica sharing the fleet's placed params
+        (no second placement), its program ladder warmed OFF the timed
+        path when the router was warmed, armed mid-run so it can
+        receive the very next routed arrival. Returns the replica
+        id."""
+        k = len(self.engines)
+        eng = InferenceEngine(self.config.serve,
+                              placed_params=self._placed_params)
+        self.engines.append(eng)
+        reg = None
+        if self.replica_registries is not None:
+            # Parity with the ctor: one per-replica serve_* registry
+            # per engine (absent entirely when the router was built
+            # without a registry — a post-hoc registry attach gets
+            # router-level metrics only).
+            from ..obs import MetricRegistry
+
+            reg = MetricRegistry()
+            self.replica_registries.append(reg)
+        sched = self._make_scheduler(eng, reg)
+        self.scheds.append(sched)
+        if self._warm_items is not None:
+            # warmup suppresses its own telemetry (Scheduler.warmup),
+            # so a mid-run spin-up emits no trace records and moves no
+            # run counters — only its compile activity lands, as
+            # xla_compiles_total on the replica registry.
+            sched.warmup(self._warm_items)
+        if self._armed:
+            sched.begin()
+        return k
+
+    def remove_replica(self, k: int, done: dict) -> None:
+        """Scale in, the graceful half: collect the drained replica's
+        completions/stats, release its run (the hardened
+        ``Scheduler.release`` — pool byte-whole, reservations
+        included), and drop it from the fleet."""
+        sched = self.scheds[k]
+        rd, stats = sched.collect()
+        sched.release()
+        done.update(rd)
+        self._collected[k] = stats
+        self._drop(k)
+
+    def kill_replica(self, k: int) -> None:
+        """Crash: discard the replica wholesale — engine, page pool and
+        armed run state are gone (the controller already harvested the
+        driver-side ledger via ``Scheduler.abandon``). No release: the
+        device state no longer exists."""
+        self._drop(k)
+
+    def _drop(self, k: int) -> None:
+        self.engines[k] = None
+        self.scheds[k] = None
+        self.draining.discard(k)
+        self._sticky = {key: r for key, r in self._sticky.items()
+                        if r != k}
+        if self.registry is not None:
+            self.registry.gauge("router_replica_outstanding").set(
+                0, replica=k
+            )
+
+    def requeue(self, req: Request, *, shed_exempt: bool = False) -> None:
+        """Put a crash-orphaned request back at the front door: it
+        re-routes at the next tick's routing pass, immediately eligible
+        (``arrival=0`` — its original arrival already passed).
+        ``shed_exempt=True`` for requests that were ALREADY ADMITTED
+        before the crash (their admission decision is not re-made).
+        Sampling keys fold in only (seed, request_id, token_index), so
+        the re-served stream is the SAME tokens. The request's trace
+        watermark is recorded so per-request SLO derivation uses its
+        FINAL serve's token emissions only — folding the crashed
+        attempt's in would duplicate ITL samples — while the ORIGINAL
+        eligibility survives: the request's TTFT honestly spans the
+        crash window (attainment must pay for the incident)."""
+        self._requeue_marks[req.id] = \
+            len(self.tracer.records) - self._rec_start
+        self._door.append((
+            dataclasses.replace(req, arrival=0, shed_exempt=shed_exempt),
+            False,
+        ))
+
+    @staticmethod
+    def _final_serve_records(records, marks: dict[int, int]) -> list:
+        """Drop a requeued request's token-emission records from BEFORE
+        its last requeue watermark (and strip it from earlier
+        decode_tick ``reqs`` lists), so ``request_slo_samples`` sees
+        one serve's emissions per request — the final one. The
+        request's FIRST ``eligible`` record is kept: its TTFT spans the
+        crash (honest end-to-end latency). Identity when nothing
+        requeued."""
+        if not marks:
+            return records
+        out = []
+        for i, rec in enumerate(records):
+            name = rec.get("name")
+            attrs = rec.get("attrs", {})
+            rid = attrs.get("req")
+            if name != "eligible" and rid in marks and i < marks[rid]:
+                continue
+            if name == "decode_tick":
+                reqs = attrs.get("reqs", ())
+                kept = [q for q in reqs
+                        if not (q in marks and i < marks[q])]
+                if len(kept) != len(reqs):
+                    rec = {**rec, "attrs": {**attrs, "reqs": kept}}
+            out.append(rec)
+        return out
+
+    def note_move(self, rid: int, dst: int) -> None:
+        """Record a preemption move in the run's placement ledger (the
+        request now lives on ``dst``)."""
+        if self._run_counters is not None:
+            self._run_counters["placements"][rid] = dst
 
     @classmethod
     def from_checkpoint(cls, config: RouterConfig, path, **kw) -> "Router":
@@ -342,20 +523,30 @@ class Router:
                    params=_load_host_params(path, config.serve.spec), **kw)
 
     def reset(self) -> None:
-        """Fresh caches/prefix pools on every replica and a cleared
-        sticky family map — two runs from the same reset point are
-        identical (the seed-determinism pin)."""
+        """Fresh caches/prefix pools on every (live) replica, a cleared
+        sticky family map, an empty door queue and reset controller
+        state — two runs from the same reset point are identical (the
+        seed-determinism pin)."""
         for eng in self.engines:
-            eng.reset()
+            if eng is not None:
+                eng.reset()
         self._sticky.clear()
+        self._door.clear()
+        self.draining.clear()
+        if self.controller is not None:
+            self.controller.reset()
 
     def warmup(self, items) -> None:
         """Compile every replica's program ladder for ``items`` outside
         any timed run (each replica may receive any request, so each
-        warms on the whole stream), then reset."""
+        warms on the whole stream), then reset. The item list is KEPT:
+        a replica the controller scales out mid-run warms on the same
+        stream, off the timed path (ISSUE 13)."""
         reqs = [self._to_request(it) for it in items]
+        self._warm_items = reqs
         for sched in self.scheds:
-            sched.warmup(reqs)
+            if sched is not None:
+                sched.warmup(reqs)
         self.reset()
 
     # -- placement policy --------------------------------------------------
@@ -384,7 +575,8 @@ class Router:
         members differ in their tails), page-ALIGNED on paged engines
         so the key covers exactly the pages a hit would share."""
         w = self.config.affinity_window
-        eng = self.engines[0]
+        live = self.live_ids()
+        eng = self.engines[live[0] if live else 0]
         if eng.paged and w >= eng.page_size:
             w -= w % eng.page_size
         k = min(int(prompt.shape[0]) - 1, w)
@@ -392,27 +584,32 @@ class Router:
             return None  # BOS alone is every prompt's prefix — no family
         return np.asarray(prompt[:k], np.int32).tobytes()
 
-    def _place(self, req: Request, pressures) -> tuple[int, str]:
-        """Choose a replica: deepest live prefix coverage first (pure
-        probes), then the sticky family map, then least load — backlog
-        (occupied + every queued request), free pages as the
-        tie-breaker, replica id as the deterministic last word."""
+    def _place(self, req: Request, cand: list[int],
+               pressures: dict) -> tuple[int, str]:
+        """Choose a replica among the ROUTABLE candidates: deepest live
+        prefix coverage first (pure probes), then the sticky family
+        map, then least load — backlog (occupied + every queued
+        request), free pages as the tie-breaker, replica id as the
+        deterministic last word. On a static fleet the candidate list
+        is every replica — byte-identical decisions to the pre-fleet
+        router."""
         key = None
         if self.config.prefix_affinity:
             depths = []
-            for eng in self.engines:
+            for k in cand:
+                eng = self.engines[k]
                 d = 0
                 if eng.prefix is not None:
                     _, d = eng.prefix.match(req.prompt)
                 depths.append(int(d))
             best = max(depths)
             if best >= MIN_PREFIX_HIT:
-                return depths.index(best), "affinity"
+                return cand[depths.index(best)], "affinity"
             key = self._family_key(req.prompt)
-            if key is not None and key in self._sticky:
+            if key is not None and self._sticky.get(key) in cand:
                 return self._sticky[key], "affinity"
         k = min(
-            range(len(self.scheds)),
+            cand,
             key=lambda i: (
                 pressures[i].occupied_slots + pressures[i].pending_total,
                 -pressures[i].pages_available,
@@ -422,24 +619,45 @@ class Router:
         return k, "load"
 
     def _route(self, req: Request, t: int, done: dict, cls_of: dict,
-               counters: dict) -> None:
+               counters: dict, *, first: bool = True) -> None:
         cls = self.classes[req.traffic_class]
         cls_of[req.id] = cls.name
-        if self.registry is not None:
+        if self.registry is not None and first:
             # EVERY arrival is an attempt — counted BEFORE the shed
             # decision, or the canonical shed-fraction SLO rule
             # (router_shed_total over router_requests_total) would read
             # burn 0.0 in an all-shed window: sheds with no admits
             # would leave the attempts denominator empty exactly when
-            # the overload is worst.
+            # the overload is worst. Door RETRIES and crash requeues
+            # are not second attempts — each request counts once (the
+            # no-double-count contract, ISSUE 13).
             self.registry.counter("router_requests_total").inc(
                 **{"class": cls.name}
             )
-        pressures = [s.pressure() for s in self.scheds]
-        if self.config.shed_threshold is not None:
+        cand = self.live_ids(routable=True)
+        if not cand:
+            # No routable replica this tick (a crash mid-heal, or the
+            # whole fleet draining): wait at the door — the controller
+            # heals before the next routing pass. Already counted as an
+            # arrival above (first=False on the retry).
+            self._door.append((req, False))
+            return
+        pressures = {k: self.scheds[k].pressure() for k in cand}
+        # While the fleet can still scale out, the door shed DEFERS —
+        # capacity is coming, and acting on load beats shedding it
+        # (ISSUE 13: the bulk-burst that fires bulk_shed on a static
+        # fleet instead triggers scale-out). At max scale the shed is
+        # the backstop again. A shed_exempt request (admitted before
+        # its replica crashed) is never re-shed — its admission was
+        # decided once.
+        defer_shed = req.shed_exempt or (
+            self.controller is not None
+            and self.controller.defers_door_shed()
+        )
+        if self.config.shed_threshold is not None and not defer_shed:
             shed_at = self.config.shed_threshold - cls.margin
-            backlog = min(p.occupied_slots + p.pending_total
-                          for p in pressures)
+            backlog = min(pressures[k].occupied_slots
+                          + pressures[k].pending_total for k in cand)
             if backlog >= shed_at:
                 # Router-level priority shed: no replica has headroom
                 # for this class's margin — refuse at the door, decided
@@ -461,7 +679,7 @@ class Router:
                         **{"class": cls.name}
                     )
                 return
-        replica, reason = self._place(req, pressures)
+        replica, reason = self._place(req, cand, pressures)
         counters["placements"][req.id] = replica
         counters["affinity" if reason == "affinity" else "load"] += 1
         if self.config.prefix_affinity:
@@ -485,10 +703,15 @@ class Router:
 
     def run(self, items) -> tuple[dict[int, Completion], RouterStats]:
         """Serve an open-loop stream to completion. Each global tick:
-        route every request whose arrival has come (shed or submit),
-        then tick every non-idle replica once, in replica order. The
-        loop fast-forwards over globally idle gaps exactly like the
-        scheduler's own tick loop."""
+        controller pre-phase (crash delivery, healing, drain
+        finalization), route the door queue then every request whose
+        arrival has come (shed or submit), controller post-phase
+        (preempt, scale), then tick every live replica once, in
+        replica order. On a static fleet (no controller) the loop
+        fast-forwards over globally idle gaps exactly like the
+        scheduler's own tick loop; with a controller every tick is
+        real — idle ticks are what drive drain decisions, and skipping
+        them would skip a seeded crash tick."""
         reqs = sorted((self._to_request(it) for it in items),
                       key=lambda r: (r.arrival, r.id))
         ids = [r.id for r in reqs]
@@ -498,6 +721,9 @@ class Router:
         cls_of: dict[int, str] = {}
         counters = {"placements": {}, "affinity": 0, "load": 0,
                     "router_sheds": 0}
+        self._run_counters = counters
+        self._collected = {}
+        self._requeue_marks: dict[int, int] = {}
         # THIS run's slice of the (possibly shared, possibly reused)
         # tracer: stats derive from records emitted after this point,
         # so a reset-and-rerun router never folds a previous run's
@@ -505,8 +731,11 @@ class Router:
         # id would otherwise pair run 1's `eligible` with run 2's
         # `first_token` — a TTFT spanning the inter-run gap).
         rec_start = len(self.tracer.records)
+        self._rec_start = rec_start
         for sched in self.scheds:
-            sched.begin()
+            if sched is not None:
+                sched.begin()
+        self._armed = True
         t = 0
         i = 0
         ticks = 0
@@ -520,14 +749,32 @@ class Router:
         # registries, invisible to the router's monitor).
         scanned = rec_start
         eligible_t: dict[int, float] = {}
+        # One LIVE sample per request: a crash-requeued request whose
+        # first attempt already reached first token must not observe a
+        # second, crash-window-excluding TTFT on its re-serve (the
+        # post-hoc ClassReport reports the end-to-end spanning value;
+        # the live histogram keeps the first genuinely-served latency).
+        ttft_observed: set[int] = set()
         shed_prev = 0
+        ctrl = self.controller
         try:
-            while i < len(reqs) or any(not s.idle for s in self.scheds):
+            while i < len(reqs) or self._door or any(
+                s is not None and not s.idle for s in self.scheds
+            ):
+                if ctrl is not None:
+                    ctrl.begin_tick(t, done)
+                if self._door:
+                    door, self._door = self._door, []
+                    for req, first in door:
+                        self._route(req, t, done, cls_of, counters,
+                                    first=first)
                 while i < len(reqs) and reqs[i].arrival <= t:
                     self._route(reqs[i], t, done, cls_of, counters)
                     i += 1
+                if ctrl is not None:
+                    ctrl.after_route(t)
                 for k, sched in enumerate(self.scheds):
-                    if not sched.idle:
+                    if sched is not None and not sched.idle:
                         sched.tick()
                 if self.registry is not None:
                     recs = self.tracer.records
@@ -535,10 +782,12 @@ class Router:
                         name = r.get("name")
                         if name == "eligible":
                             # setdefault: FIRST eligible wins, the
-                            # request_slo_samples definition.
-                            eligible_t.setdefault(
-                                r["attrs"]["req"], r["t"]
-                            )
+                            # request_slo_samples definition; a rid
+                            # already observed (crash re-serve) never
+                            # re-enters the ledger.
+                            rid = r["attrs"]["req"]
+                            if rid not in ttft_observed:
+                                eligible_t.setdefault(rid, r["t"])
                         elif name == "first_token":
                             rid = r["attrs"]["req"]
                             if rid in eligible_t and rid in cls_of:
@@ -548,9 +797,12 @@ class Router:
                                     r["t"] - eligible_t.pop(rid),
                                     **{"class": cls_of[rid]},
                                 )
+                                ttft_observed.add(rid)
                     scanned = len(recs)
                     total_backlog = 0
                     for k, sched in enumerate(self.scheds):
+                        if sched is None:
+                            continue
                         p = sched.pressure()
                         outstanding = p.occupied_slots + p.pending_total
                         total_backlog += outstanding
@@ -575,16 +827,28 @@ class Router:
                     self.slo_monitor.tick()
                 ticks += 1
                 t += 1
-                if i < len(reqs) and all(s.idle for s in self.scheds):
+                if ctrl is None and i < len(reqs) \
+                        and all(s.idle for s in self.scheds):
+                    # Static-fleet fast-forward only: with a controller
+                    # every tick is real (docstring).
                     t = max(t, reqs[i].arrival)
-            per_replica = [sched.collect() for sched in self.scheds]
+            if ctrl is not None:
+                ctrl.finish(t, done)
+            for k, sched in enumerate(self.scheds):
+                if sched is None:
+                    continue
+                rd, s = sched.collect()
+                done.update(rd)
+                self._collected[k] = s
         finally:
+            self._armed = False
+            self._run_counters = None
             for sched in self.scheds:
-                sched.release()
-        for rd, _ in per_replica:
-            done.update(rd)
-        stats = self._stats(done, cls_of, counters,
-                            [s for _, s in per_replica], ticks,
+                if sched is not None:
+                    sched.release()
+        replica_stats = [self._collected.get(k)
+                         for k in range(len(self.engines))]
+        stats = self._stats(done, cls_of, counters, replica_stats, ticks,
                             self.tracer.records[rec_start:])
         return done, stats
 
@@ -592,7 +856,9 @@ class Router:
                records) -> RouterStats:
         from ..utils.metrics import StepStats
 
-        samples = request_slo_samples(records)
+        samples = request_slo_samples(
+            self._final_serve_records(records, self._requeue_marks)
+        )
         per_class: dict[str, ClassReport] = {}
         for name, spec in self.classes.items():
             members = [rid for rid, c in cls_of.items() if c == name]
@@ -646,6 +912,8 @@ class Router:
             router_sheds=counters["router_sheds"],
             ticks=ticks,
             replica=list(replica_stats),
+            fleet=(self.controller.summary()
+                   if self.controller is not None else None),
         )
 
 

@@ -143,7 +143,14 @@ class Request:
 
     ``traffic_class`` (ISSUE 8) names the request's SLO class for the
     multi-replica router (``serve.router``) — the scheduler itself
-    ignores it; per-class accounting lives one layer up."""
+    ignores it; per-class accounting lives one layer up.
+
+    ``shed_exempt`` (ISSUE 13): the admission-shed check skips this
+    request. Set by the fleet controller when re-queuing a request that
+    was ALREADY ADMITTED before its replica crashed — its admission
+    decision was made once and must not be re-made against the
+    post-crash backlog (a crash must never convert served work into a
+    refusal)."""
 
     id: int
     prompt: np.ndarray  # int32 [p], p >= 1
@@ -152,14 +159,19 @@ class Request:
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
     traffic_class: str = "default"
+    shed_exempt: bool = False
 
 
 @dataclasses.dataclass
 class Completion:
     """``status`` is the structured outcome: ``"ok"`` (ran to its stop
     condition), ``"deadline_exceeded"`` (evicted at a TTFT/total
-    deadline — ``tokens`` holds the partial output), or ``"shed"``
-    (refused at admission under overload; never occupied a slot)."""
+    deadline — ``tokens`` holds the partial output), ``"shed"``
+    (refused at admission under overload; never occupied a slot), or
+    ``"requeued"`` (ISSUE 13: a TRANSIENT placeholder the fleet
+    controller writes for a crash-orphaned request — overwritten
+    exactly once by the final completion when the re-run lands; it
+    survives only if the run is torn down before the fleet heals)."""
 
     id: int
     prompt_len: int
@@ -210,6 +222,28 @@ class ServeStats:
     def prefix_hit_rate(self) -> float:
         return (self.prefix_hits / self.prefix_lookups
                 if self.prefix_lookups else 0.0)
+
+
+@dataclasses.dataclass
+class PreemptedRequest:
+    """A mid-decode request lifted out of one scheduler for resumption
+    on another (ISSUE 13, ``serve.controller``): the request, its
+    generated-so-far stream, the decode cursor, and its KV pages
+    serialized host-side (``engine.dump_slot_pages`` — bit-exact rows,
+    block-table order). ``eligible_wall`` carries the ORIGINAL
+    eligibility stamp so deadlines keep their meaning across the move,
+    and ``admitted_at`` the original admission step so the eventual
+    ``Completion`` reports the request's true admission."""
+
+    request: Request
+    generated: list[int]
+    last_token: int
+    lengths: int
+    admitted_at: int
+    eligible_wall: float
+    k: np.ndarray  # [L, n_pages, page, H, D]
+    v: np.ndarray  # [L, n_pages, page, H, D]
+    pos: np.ndarray  # [n_pages, page]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -749,6 +783,162 @@ class Scheduler:
             prefix_entries=len(eng.prefix) if eng.prefix is not None else 0,
         )
 
+    def waiting_eligible_requests(self) -> list[Request]:
+        """The queued requests whose arrival has come but which hold no
+        slot yet, in admission (FIFO) order — the fleet controller's
+        preemption-trigger probe (ISSUE 13). Read-only, like
+        :meth:`pressure`."""
+        st = self._st
+        if st is None:
+            return []
+        out = []
+        for q in st.pending:  # (arrival, id)-sorted: early break
+            if q.arrival > st.step:
+                break
+            out.append(q)
+        return out
+
+    def occupant_requests(self) -> list[tuple[int, Request, bool]]:
+        """``(slot, request, active)`` for every occupied slot — the
+        controller's preemption-victim probe (only ACTIVE occupants are
+        preemptable; a mid-prefill slot has no decode cursor to move).
+        Read-only."""
+        st = self._st
+        if st is None:
+            return []
+        return [(s, r, bool(st.active[s]))
+                for s, r in enumerate(st.occupant) if r is not None]
+
+    # -- cross-replica preemption (ISSUE 13) --------------------------------
+
+    def preempt(self, request_id: int) -> PreemptedRequest:
+        """Lift an ACTIVE (mid-decode) occupant out of the armed run for
+        resumption on another scheduler (``adopt``): serialize its
+        resident pages host-side, free its slot — pages decref (shared
+        prefix pages survive on their entry's reference), any unused
+        admission reservation returns, pinned prefix refs release — and
+        forget the occupant WITHOUT recording a completion (it completes
+        exactly once, on the adopting scheduler). Paged engines only:
+        slot-independent refcounted pages are what make the hand-off a
+        serialize/deserialize, not a recompute — the resumed tokens are
+        bit-identical by construction (pinned in tests/test_fleet.py)."""
+        st = self._require_run()
+        eng = self.engine
+        if not eng.paged:
+            raise RuntimeError(
+                "preempt needs the paged KV layout (page_size > 0) — "
+                "contiguous slots have no slot-independent pages to "
+                "hand off"
+            )
+        for s in range(eng.config.slots):
+            r = st.occupant[s]
+            if r is not None and r.id == request_id:
+                break
+        else:
+            raise KeyError(
+                f"request {request_id} occupies no slot on this scheduler"
+            )
+        if not st.active[s]:
+            raise RuntimeError(
+                f"request {request_id} is mid-prefill, not mid-decode — "
+                "only active occupants carry a resumable decode cursor"
+            )
+        k, v, pos = eng.dump_slot_pages(s)
+        pre = PreemptedRequest(
+            request=r,
+            generated=list(st.generated[s]),
+            last_token=int(st.last_tokens[s]),
+            lengths=int(st.lengths[s]),
+            admitted_at=int(st.admitted_at[s]),
+            eligible_wall=st.eligible_wall[r.id],
+            k=k, v=v, pos=pos,
+        )
+        st.active[s] = False
+        st.occupant[s] = None
+        # The id no longer lives here — and may legitimately come back
+        # (a later crash of the adopting replica requeues it anywhere).
+        st.seen_ids.discard(r.id)
+        eng.release_slot(s)
+        if st.held_entry[s] >= 0:
+            eng.prefix_release(st.held_entry[s])
+            st.held_entry[s] = -1
+        if self.tracer:
+            self.tracer.event("preempt", req=int(r.id), slot=s,
+                              step=st.step, tokens=len(pre.generated))
+        return pre
+
+    def adopt(self, pre: PreemptedRequest) -> int:
+        """Install a preempted request into a free slot of the armed
+        run, resuming exactly where the source left off: its serialized
+        pages become fresh resident pages (``engine.load_slot_pages``),
+        the decode cursor (``lengths``/``last_token``) carries over, and
+        the sampling key — (seed, request_id, token_index) only — makes
+        the continuation's tokens bit-identical to an unpreempted run.
+        Reserves the request's remaining worst case like a normal
+        admission (reclaiming zero-ref prefix entries if short).
+        Returns the slot."""
+        st = self._require_run()
+        eng = self.engine
+        if not eng.paged:
+            raise RuntimeError(
+                "adopt needs the paged KV layout (page_size > 0)"
+            )
+        r = pre.request
+        if r.id in st.seen_ids:
+            raise ValueError(
+                f"adopt: request id {r.id} already seen on this scheduler"
+            )
+        slot = next((s for s in range(eng.config.slots)
+                     if st.occupant[s] is None), None)
+        if slot is None:
+            raise RuntimeError("adopt: no free slot on this scheduler")
+        p = int(np.asarray(r.prompt).shape[0])
+        need = eng.pages_needed(p + r.max_new_tokens)
+        if eng.pages.available < need and not eng.reclaim_pages(need):
+            raise RuntimeError(
+                f"adopt: request {r.id} needs {need} pages but only "
+                f"{eng.pages.available} are available — the controller "
+                "must check pages_available before choosing this replica"
+            )
+        eng.reserve_pages(slot, need)
+        eng.load_slot_pages(slot, pre.k, pre.v, pre.pos)
+        st.seen_ids.add(r.id)
+        st.occupant[slot] = r
+        st.active[slot] = True
+        st.generated[slot] = list(pre.generated)
+        st.lengths[slot] = pre.lengths
+        st.last_tokens[slot] = pre.last_token
+        st.req_ids[slot] = r.id
+        st.admitted_at[slot] = pre.admitted_at
+        st.prefilled[slot] = p
+        st.store_after[slot] = False
+        st.held_entry[slot] = -1
+        st.eligible_wall[r.id] = pre.eligible_wall
+        st.deadlines_on = st.deadlines_on or (
+            r.ttft_deadline_s is not None or r.deadline_s is not None
+        )
+        if self.tracer:
+            self.tracer.event("resume", req=int(r.id), slot=slot,
+                              step=st.step, tokens=len(pre.generated))
+        return slot
+
+    def abandon(self) -> tuple[dict[int, Completion], list[Request],
+                               list[Request]]:
+        """Crash harvest (ISSUE 13, ``serve.controller``): hand back the
+        armed run's DRIVER-side bookkeeping — completions already
+        finished, the requests resident in slots (in-flight, their
+        device state lost), and the still-queued requests — and disarm
+        WITHOUT touching the engine: a crashed replica's device state is
+        gone, the engine is discarded wholesale with its page pool, so
+        there is nothing to release. The host ledger survives a replica
+        crash exactly as a real front door's would."""
+        st = self._require_run()
+        inflight = [r for r in st.occupant if r is not None]
+        queued = list(st.pending)
+        done = dict(st.done)
+        self._st = None
+        return done, inflight, queued
+
     def collect(self) -> tuple[dict[int, Completion], ServeStats]:
         """Finalize the armed run: flush the run-total counters into
         the registry and return ``(completions, stats)`` exactly as
@@ -785,7 +975,15 @@ class Scheduler:
         registration, and (paged) leaked page references would shrink
         the pool for every future run. No-op after a clean ``collect``
         (normal completion already released everything in
-        ``_finish``)."""
+        ``_finish``).
+
+        The paged sweep covers every slot holding mapped pages OR an
+        outstanding admission RESERVATION, occupant or not (ISSUE 13
+        satellite): an abort between a reservation and its occupant —
+        or any state a preempt/adopt left mid-flight — must still
+        return the pool byte-whole, reservations included (pinned in
+        tests/test_serve_paged.py: free == num_pages and reserved == 0
+        after release on an engine without pinned prefix entries)."""
         st = self._st
         if st is None:
             return
@@ -794,7 +992,9 @@ class Scheduler:
             if st.held_entry[s] >= 0:
                 eng.prefix_release(st.held_entry[s])
                 st.held_entry[s] = -1
-            if eng.paged and st.occupant[s] is not None:
+            if eng.paged and (st.occupant[s] is not None
+                              or int(eng.table_len[s])
+                              or int(eng.reserved_for[s])):
                 eng.release_slot(s)
         self._st = None
 
@@ -937,7 +1137,8 @@ class Scheduler:
                 break  # pending is (arrival, id)-sorted
             if r.id not in st.eligible_wall:
                 if self.shed_threshold is not None \
-                        and outstanding >= self.shed_threshold:
+                        and outstanding >= self.shed_threshold \
+                        and not r.shed_exempt:
                     shed_now.append(r)
                     continue
                 st.eligible_wall[r.id] = now

@@ -382,6 +382,60 @@ def test_main_serve_router_flag_hygiene():
         main(["serve", "--platform", "cpu", "--replicas", "0"])
 
 
+def test_main_serve_autoscale_end_to_end(capsys):
+    """ISSUE 13 CLI surface: ``--autoscale`` + ``--max-replicas`` on a
+    bursty stream scales the fleet out and back in; the JSON contract
+    carries the controller digest (scale events, drains, the event
+    ledger) under router.fleet, and every request resolves to a final
+    status."""
+    model = ["--vocab", "16", "--d-model", "32", "--heads", "2",
+             "--layers", "2", "--d-ff", "64"]
+    assert main([
+        "serve", "--platform", "cpu", "--replicas", "1", "--slots", "1",
+        "--capacity", "64", "--shed-threshold", "2",
+        "--autoscale", "backlog=2,sustain=2,idle=4", "--max-replicas", "2",
+        "--slo", "bulk:priority=1,margin=1",
+        "--traffic",
+        "horizon=12;seed=0;max_requests=10;burst=3:4:5.0:bulk;"
+        "chat:rate=0.3,pmin=4,pmax=8,new=2;"
+        "bulk:rate=0.4,pmin=4,pmax=8,new=2",
+        "--json"] + model) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    fleet = payload["router"]["fleet"]
+    assert fleet["max_replicas"] == 2
+    assert fleet["scale_outs"] >= 1 and fleet["scale_ins"] >= 1
+    assert fleet["crashes"] == 0
+    kinds = [e["kind"] for e in fleet["events"]]
+    assert "scale_out" in kinds and "drain" in kinds
+    for row in payload["per_class"].values():
+        assert row["total"] == row["ok"] + row["shed"] \
+            + row["deadline_exceeded"]
+
+
+def test_main_serve_autoscale_flag_hygiene():
+    """Fleet flag hygiene: --autoscale needs --replicas, --max-replicas
+    needs --autoscale, replica_crash needs the controller, and
+    malformed autoscale specs are named config errors."""
+    with pytest.raises(SystemExit, match="--autoscale requires --replicas"):
+        main(["serve", "--platform", "cpu", "--autoscale", "backlog=2"])
+    with pytest.raises(SystemExit,
+                       match="--max-replicas requires --autoscale"):
+        main(["serve", "--platform", "cpu", "--max-replicas", "2"])
+    with pytest.raises(SystemExit, match="--autoscale"):
+        main(["lm", "--autoscale", "backlog=2"])
+    with pytest.raises(SystemExit, match="fleet cap"):
+        main(["serve", "--platform", "cpu", "--replicas", "1",
+              "--autoscale", "backlog=2"])
+    with pytest.raises(SystemExit, match="unknown autoscale key"):
+        main(["serve", "--platform", "cpu", "--replicas", "1",
+              "--autoscale", "frob=1", "--max-replicas", "2"])
+    with pytest.raises(SystemExit, match="replica_crash needs --autoscale"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--inject-fault", "replica_crash@3:1"])
+    with pytest.raises(SystemExit, match="applies to the serve variant"):
+        main(["lm", "--inject-fault", "replica_crash@3:1"])
+
+
 def test_main_serve_rejects_bad_prefix_chunk_flags():
     """Flag hygiene both ways: serve-only prefix/chunk flags fail
     loudly on training variants, and invalid combinations fail as

@@ -30,8 +30,13 @@ Bounds (per test function, per run):
   least the SUM of literal ``replicas=`` over ``Router`` /
   ``RouterConfig`` constructor sites (ISSUE 8: every replica is its own
   compiled engine, and a test building two N-replica routers pays 2N
-  compiles). ``pytest.mark.parametrize`` cases are separate tier-1
-  tests and are deliberately NOT multiplied in.
+  compiles), AND at least the SUM of literal ``max_replicas=`` over
+  ``Router``/``RouterConfig``/``FleetController``/``AutoscaleConfig``
+  sites (ISSUE 13: an autoscaled fleet can grow to its cap, and every
+  scaled-out replica compiles its own program ladder — the cap ledger
+  already subsumes the seed replicas, so the bound takes the LARGEST of
+  the three ledgers, not their sum). ``pytest.mark.parametrize`` cases
+  are separate tier-1 tests and are deliberately NOT multiplied in.
 
 The estimate is a documented LOWER bound: unresolvable (non-literal)
 values contribute nothing, so the audit can miss creative obfuscation
@@ -51,6 +56,7 @@ _PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts",
                    "synthesize_longtail_prompts", "synthesize_mixed_traffic")
 _ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
 _ROUTER_CTORS = ("Router", "RouterConfig")
+_FLEET_CTORS = ("FleetController", "AutoscaleConfig")
 
 
 def _call_name(node: ast.Call) -> str | None:
@@ -112,18 +118,20 @@ def estimate(fn) -> tuple[bool, int, int]:
     max_new = 0
     topologies = 1
     router_replicas = 0
+    fleet_caps = 0
     for node in ast.walk(fn):
         if id(node) in skip:
             continue
         if isinstance(node, ast.Name) and node.id in (
             "Scheduler", "Router", "SloMonitor", "AnomalyDetector",
-            "GoodputTracker",
+            "GoodputTracker", "FleetController", "Autoscaler",
         ):
             # SloMonitor (ISSUE 10) / AnomalyDetector + GoodputTracker
-            # (ISSUE 11): the SLO/anomaly/goodput tests drive
-            # schedulers and routers through those surfaces — any of
-            # these names alone marks the test as scheduler-driving,
-            # so the observability tests count into the same budgets.
+            # (ISSUE 11) / FleetController + Autoscaler (ISSUE 13): the
+            # SLO/anomaly/goodput/fleet tests drive schedulers and
+            # routers through those surfaces — any of these names alone
+            # marks the test as scheduler-driving, so the observability
+            # and fleet tests count into the same budgets.
             uses_scheduler = True
         if isinstance(node, ast.For) and isinstance(
             node.iter, (ast.Tuple, ast.List)
@@ -146,10 +154,17 @@ def estimate(fn) -> tuple[bool, int, int]:
             v = _kw_int(node, "max_new_tokens")
             if v is not None:
                 max_new = max(max_new, v)
-        elif name in _ROUTER_CTORS:
+        elif name in _ROUTER_CTORS + _FLEET_CTORS:
             v = _kw_int(node, "replicas")
             if v is not None:
                 router_replicas += v
+            # ISSUE 13: an autoscaled fleet can grow to max_replicas
+            # engines — the cap ledger sums across sites and the final
+            # bound takes the LARGEST ledger (the cap subsumes the
+            # seed replicas of the router it governs).
+            v = _kw_int(node, "max_replicas")
+            if v is not None:
+                fleet_caps += v
         elif name == "synthesize_prompts":
             v = _kw_int(node, "num")
             if v is not None:
@@ -167,7 +182,8 @@ def estimate(fn) -> tuple[bool, int, int]:
             if v is not None:
                 prompt_set = max(prompt_set, v)
     tokens = max(prompt_set, request_sites) * max_new
-    return uses_scheduler, tokens, max(topologies, router_replicas)
+    return uses_scheduler, tokens, max(topologies, router_replicas,
+                                       fleet_caps)
 
 
 def _audit(tree) -> list[tuple[str, int, int]]:
@@ -401,6 +417,50 @@ def test_anomaly_goodput_audit_estimator_extension():
     assert uses and tokens == 200 and topo == 1
     uses, tokens, _ = estimate(fns["test_goodput_in_budget"])
     assert uses and tokens == 16
+
+
+def test_fleet_audit_estimator_extension():
+    """ISSUE 13 self-pin: a ``FleetController``/``Autoscaler`` name
+    alone marks a test as scheduler-driving, and ``max_replicas=``
+    literals SUM into the topology budget (the fleet can grow to its
+    cap; the cap ledger subsumes the seed replicas, so the bound is the
+    largest of the three ledgers — a 1-replica router under a
+    max_replicas=4 controller counts 4 engines, while replicas=2 with
+    max_replicas=2 stays in budget)."""
+    src = textwrap.dedent("""
+        def test_fleet_cap_overrun():
+            ctrl = FleetController(AutoscaleConfig(max_replicas=4))
+            r = Router(RouterConfig(serve=ServeConfig(), replicas=1),
+                       controller=ctrl)
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=2)},
+                max_requests=4)
+            r.run(t)
+
+        def test_fleet_in_budget():
+            ctrl = FleetController(AutoscaleConfig(max_replicas=2,
+                                                   min_replicas=2))
+            r = Router(RouterConfig(serve=ServeConfig(), replicas=2),
+                       controller=ctrl)
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=2)},
+                max_requests=4)
+            r.run(t)
+
+        def test_autoscaler_name_marks():
+            sim = Autoscaler()
+            sim.step()
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_fleet_cap_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_fleet_cap_overrun"])
+    assert uses and tokens == 8 and topo == 4
+    uses, tokens, topo = estimate(fns["test_fleet_in_budget"])
+    assert uses and tokens == 8 and topo == 2
+    uses, tokens, topo = estimate(fns["test_autoscaler_name_marks"])
+    assert uses and tokens == 0 and topo == 1
 
 
 def test_fault_injection_tests_carry_slow_marker():

@@ -24,6 +24,7 @@ import pytest
 
 from ddl_tpu.data.lm import (
     synthesize_longtail_prompts,
+    synthesize_prompts,
     synthesize_shared_prefix_prompts,
 )
 from ddl_tpu.models.transformer import TINY_SPEC
@@ -425,3 +426,44 @@ def test_paged_deadline_eviction_releases_pages_and_keeps_parity():
             )
             assert again[3].status == "ok"
     assert outs[0] == outs[8]  # paged ≡ contiguous under eviction
+
+
+def test_release_returns_pool_byte_whole_reservations_included():
+    """ISSUE 13 satellite: aborting an armed run mid-flight — occupants
+    decoding, admission reservations outstanding, a mid-prefill slot —
+    returns the pool BYTE-WHOLE through ``Scheduler.release()``: every
+    page back on the free list AND every reservation cancelled (the
+    abort path used to sweep only occupied slots' mapped pages; a
+    drained/aborted replica must hand back promised-not-yet-mapped
+    capacity too). The engine is then fully reusable."""
+    eng = InferenceEngine(ServeConfig(
+        spec=SPEC, slots=3, capacity=32, page_size=8, num_pages=8,
+        prefill_chunk=8,
+    ))
+    prompts = synthesize_prompts(num=3, min_len=6, max_len=12,
+                                 vocab=SPEC.vocab, seed=4)
+    sched = Scheduler(eng)
+    sched.begin()
+    for i, p in enumerate(prompts):
+        sched.submit(Request(id=i, prompt=p, max_new_tokens=12))
+    for _ in range(2):
+        sched.tick()
+    # Mid-flight: pages mapped AND reservations outstanding.
+    assert eng.pages.free < eng.num_pages
+    assert eng.pages.reserved > 0
+    # The fixed gap: a reservation on a slot with NO occupant (an
+    # admission/adopt interrupted between reserve and install) — the
+    # occupant-only sweep missed exactly this.
+    free_slot = next(s for s in range(3)
+                     if sched._st.occupant[s] is None)
+    eng.reserve_pages(free_slot, 1)
+    sched.release()
+    assert eng.pages.free == eng.num_pages  # every page back
+    assert eng.pages.reserved == 0  # every reservation cancelled
+    assert (eng.table_len == 0).all()
+    assert (eng.reserved_for == 0).all()
+    # Reusable: a fresh run on the same engine completes cleanly.
+    done, _ = Scheduler(eng).run(
+        [Request(id=9, prompt=prompts[0], max_new_tokens=2)]
+    )
+    assert done[9].status == "ok"
