@@ -49,6 +49,15 @@ Plus head-to-head sections (ISSUE 4/7; skip with ``--skip-compare``):
   attainment, the shed ledger, the controller's scale-event digest and
   an observed-time-weighted goodput fraction — all read from the
   registries.
+- **disagg_compare** (ISSUE 15) — disaggregated prefill/decode +
+  speculative decoding: the same seeded stream served colocated
+  (2 mixed replicas), role-split (1 prefill + 1 decode, first-token
+  page hand-offs), and role-split + speculative (k-token n-gram drafts
+  verified through free decode-batch lanes). Per-class ITL from the
+  router registry, the hand-off ledger, tokens-per-target-step (the
+  speculation lever — > 1 when drafts accept) with the acceptance
+  rate, and a ``tokens_identical`` bit across ALL THREE arms (both
+  transparency contracts checked in situ).
 - **longtail_compare** (ISSUE 7) — capacity POOLING made concrete: a
   long-tail prompt mix under one fixed row budget. The slot-major arm
   (budget / slots rows per slot) must REJECT the long requests at
@@ -661,6 +670,129 @@ def main() -> None:
                         "error": str(e)[:300],
                     }
 
+    # -- disaggregated prefill/decode + speculative decoding (ISSUE 15):
+    # the same seeded stream served colocated (2 mixed replicas), role-
+    # split (1 prefill + 1 decode), and role-split + speculative —
+    # tokens_identical checked in situ across ALL arms, per-class ITL
+    # read from the router registry, hand-off ledger from the disagg
+    # digest, and the acceptance rate from the replica registries ----------
+    disagg_compare = {}
+    if not args.skip_compare:
+        import dataclasses as _dc2
+
+        from ddl_tpu.data.lm import synthesize_mixed_traffic as _mix
+        from ddl_tpu.obs import MetricRegistry as _Reg
+        from ddl_tpu.serve import ClassSpec as _Cls
+        from ddl_tpu.serve import Router as _Router
+        from ddl_tpu.serve import RouterConfig as _RCfg
+
+        if left() < 240:
+            note = "deadline: disagg_compare skipped"
+            disagg_compare["skipped"] = note
+            print(f"[serve_bench] {note}", file=sys.stderr)
+        else:
+            # Long answers on a small vocab: greedy decode settles into
+            # n-gram loops — the prompt-lookup-friendly workload where
+            # drafts actually accept. Slots exceed the concurrent load:
+            # draft lanes are FREE slots, and a saturated batch would
+            # degrade the speculative arm to plain decode (the
+            # documented when-k-hurts trade, measured not hidden).
+            dg_traffic = _mix(
+                classes={"chat": dict(rate=0.4, prompt_min=8,
+                                      prompt_max=16,
+                                      max_new_tokens=32)},
+                horizon=12, vocab=args.vocab, seed=5, max_requests=6,
+            )
+            dg_base = _RCfg(
+                serve=ServeConfig(**{**base_cfg, "slots": 4},
+                                  page_size=args.page_size),
+                replicas=2,
+                classes=(_Cls("chat", ttft_slo_s=5.0, itl_slo_s=0.5),),
+            )
+            arms = (
+                ("colocated", None, 0),
+                ("disagg", ("prefill", "decode"), 0),
+                ("disagg_speculate", ("prefill", "decode"), 4),
+            )
+            completions = {}
+            for label, roles, spec_k in arms:
+                try:
+                    rcfg = _dc2.replace(
+                        dg_base, roles=roles,
+                        serve=_dc2.replace(dg_base.serve,
+                                           speculate_k=spec_k),
+                    )
+                    reg = _Reg()
+                    router = _Router(rcfg, registry=reg)
+                    router.warmup(dg_traffic)
+                    done, rs = router.run(dg_traffic)
+                    completions[label] = {i: done[i].tokens
+                                          for i in done}
+                    itl = reg.histogram("router_itl_seconds").stats(
+                        **{"class": "chat"}
+                    )
+                    dec_steps = dec_tokens = prop = acc = 0
+                    for rg in router.replica_registries:
+                        h = rg.get("serve_decode_step_seconds")
+                        if h is not None:
+                            dec_steps += h.stats().steps
+                        c = rg.get("serve_decode_tokens_total")
+                        if c is not None:
+                            dec_tokens += int(c.value())
+                        for nm in ("speculate_proposed_total",
+                                   "speculate_accepted_total"):
+                            c = rg.get(nm)
+                            if c is None:
+                                continue
+                            if nm.startswith("speculate_proposed"):
+                                prop += int(c.value())
+                            else:
+                                acc += int(c.value())
+                    # Per-SLOT tokens per target step: each (call,
+                    # active-slot) pair emits 1 + its accepted drafts,
+                    # so slot-steps = tokens - accepted and the plain
+                    # arms read exactly 1.0 — batching width cannot
+                    # masquerade as speculation.
+                    slot_steps = dec_tokens - acc
+                    row = {
+                        "itl_ms": {"p50": round(itl.p50_ms, 2),
+                                   "p95": round(itl.p95_ms, 2)},
+                        "decode_calls": dec_steps,
+                        "decode_tokens": dec_tokens,
+                        "tokens_per_target_step":
+                            round(dec_tokens / slot_steps, 3)
+                            if slot_steps else 0.0,
+                    }
+                    if rs.disagg is not None:
+                        row["handoffs"] = rs.disagg["handoffs"]
+                        row["handoff_pages"] = \
+                            rs.disagg["handoff_pages"]
+                    if spec_k:
+                        row["speculate"] = {
+                            "k": spec_k, "proposed": prop,
+                            "accepted": acc,
+                            "acceptance": round(acc / prop, 3)
+                            if prop else 0.0,
+                        }
+                    disagg_compare[label] = row
+                    print(f"[serve_bench] disagg {label}: "
+                          f"{row['tokens_per_target_step']} tok/step, "
+                          f"itl p95 {row['itl_ms']['p95']:.1f}ms",
+                          file=sys.stderr)
+                except Exception as e:  # noqa: BLE001
+                    failed[f"disagg_{label}"] = {
+                        "error_type": type(e).__name__,
+                        "error": str(e)[:300],
+                    }
+            if len(completions) == len(arms):
+                # The double transparency contract, checked in situ:
+                # disaggregation AND speculation serve the colocated
+                # fleet's exact tokens.
+                disagg_compare["tokens_identical"] = all(
+                    completions[label] == completions["colocated"]
+                    for label, _, _ in arms
+                )
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -739,6 +871,7 @@ def main() -> None:
         "longtail_compare": longtail_compare,
         "router_compare": router_compare,
         "fleet_compare": fleet_compare,
+        "disagg_compare": disagg_compare,
         "prefix_len": args.prefix_len,
         "prefill_chunk": args.prefill_chunk,
         "page_size": args.page_size,

@@ -459,6 +459,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fleet cap for --autoscale (overrides its max= "
                          "key); every replica is a full engine — compiled "
                          "programs + its own KV pool")
+    sv.add_argument("--roles", default=None, metavar="SPEC",
+                    help="disaggregated prefill/decode fleet "
+                         "(ddl_tpu.serve.disagg): comma-joined "
+                         "ROLE=COUNT segments (prefill/decode/mixed) "
+                         "summing to --replicas. Arrivals land on "
+                         "prefill replicas; on first token the "
+                         "finished prefix PAGES hand off to a decode "
+                         "replica (the compiled whole-page write "
+                         "program). Needs --replicas and --page-size "
+                         "> 0, and both sides present; per-role "
+                         "autoscale knobs ride in --autoscale as "
+                         "ROLE.key=val")
+    sv.add_argument("--speculate", default=None, metavar="K[,METHOD]",
+                    help="speculative decoding "
+                         "(ddl_tpu.serve.speculate): draft up to K "
+                         "tokens per active slot per tick by n-gram "
+                         "lookup (METHOD 'ngram' over prompt+generated "
+                         "— the default — or 'prompt' for prompt-only "
+                         "lookup) and verify them through FREE slots "
+                         "of the one batched decode call (greedy-"
+                         "accept: output is BIT-IDENTICAL to plain "
+                         "greedy decode; acceptance measured as "
+                         "speculate_accepted_total / "
+                         "speculate_proposed_total). Needs --replicas, "
+                         "--page-size > 0, temperature 0 and "
+                         "--slots >= 2")
     sv.add_argument("--slo", default=None, metavar="SPEC",
                     help="per-class SLO targets/priorities for "
                          "--replicas: ';'-joined NAME:ttft=S,itl=S,"
@@ -701,6 +727,7 @@ _SERVE_ONLY_DESTS = (
     "prompt_max", "temperature", "top_k", "prefix_cache", "prefill_chunk",
     "prefill_budget", "ttft_deadline", "request_deadline", "shed_threshold",
     "replicas", "traffic", "slo", "slo_rules", "autoscale", "max_replicas",
+    "roles", "speculate",
 )
 
 
@@ -1045,6 +1072,29 @@ def _run_lm(args) -> int:
     return 0
 
 
+def _parse_speculate(text: str) -> tuple[int, str]:
+    """``--speculate`` grammar: ``K`` or ``K,METHOD`` (methods from
+    ``serve.speculate.SPECULATE_METHODS`` — ONE list, shared with the
+    engine's validation). Deep validation (paged layout, greedy,
+    slots) lives with the ServeConfig consumer — the engine ctor."""
+    from .serve.speculate import SPECULATE_METHODS
+
+    head, _, method = text.partition(",")
+    try:
+        k = int(head.strip())
+    except ValueError:
+        raise ValueError(f"draft length {head.strip()!r} must be an int")
+    if k < 1:
+        raise ValueError(f"draft length must be >= 1, got {k}")
+    method = method.strip() or "ngram"
+    if method not in SPECULATE_METHODS:
+        raise ValueError(
+            f"unknown method {method!r} "
+            f"(valid: {', '.join(SPECULATE_METHODS)})"
+        )
+    return k, method
+
+
 def _class_tallies(done, cls_of) -> dict:
     """Per-class completion/status tallies for the serve JSON (ISSUE 8
     satellite): chaos chains assert shedding hit the RIGHT class from
@@ -1086,6 +1136,14 @@ def _run_serve_router(args, cfg) -> int:
                 "--replicas (per-class prompt/token shapes come from "
                 "--traffic)"
             )
+    roles = None
+    if args.roles is not None:
+        from .serve.disagg import parse_roles_spec
+
+        try:
+            roles = parse_roles_spec(args.roles, args.replicas)
+        except ValueError as e:
+            raise SystemExit(f"--roles: {e}")
     try:
         gen_kw = (parse_traffic_spec(args.traffic) if args.traffic
                   else {"classes": dict(DEFAULT_TRAFFIC_CLASSES)})
@@ -1100,6 +1158,7 @@ def _run_serve_router(args, cfg) -> int:
             shed_threshold=args.shed_threshold,
             ttft_deadline_s=args.ttft_deadline,
             deadline_s=args.request_deadline,
+            roles=roles,
         )
     except ValueError as e:
         raise SystemExit(f"serve config error: {e}")
@@ -1215,12 +1274,46 @@ def _run_serve_router(args, cfg) -> int:
               f"{fl['scale_outs']} in {fl['scale_ins']} (drains "
               f"{fl['drains']}) | preemptions {fl['preemptions']} | "
               f"crashes {fl['crashes']} (requeues {fl['requeues']})")
+    if rstats.disagg is not None:
+        dg = rstats.disagg
+        role_str = " ".join(f"{r}={n}" for r, n in
+                            sorted(dg["roles"].items()))
+        print(f"disagg: roles {role_str} | handoffs {dg['handoffs']} "
+              f"({dg['handoff_pages']} pages)")
+    spec_digest = None
+    if cfg.speculate_k and router.replica_registries:
+        # Non-creating reads over the per-replica registries (the
+        # MetricRegistry.get discipline): sum the acceptance ledger.
+        prop = acc = 0
+        for rg in router.replica_registries:
+            for name in ("speculate_proposed_total",
+                         "speculate_accepted_total"):
+                c = rg.get(name)
+                if c is None:
+                    continue
+                v = int(sum(c.value(**ls) for ls in c.label_sets()))
+                if name.startswith("speculate_proposed"):
+                    prop += v
+                else:
+                    acc += v
+        spec_digest = {
+            "k": cfg.speculate_k,
+            "method": cfg.speculate_method,
+            "proposed": prop,
+            "accepted": acc,
+            "acceptance": round(acc / prop, 3) if prop else None,
+        }
+        print(f"speculate: k={cfg.speculate_k} "
+              f"({cfg.speculate_method}) | accepted {acc}/{prop} "
+              f"drafts"
+              + (f" ({acc / prop:.0%})" if prop else ""))
     if args.json:
         print(json.dumps({
             "variant": "serve",
             "config": dataclasses.asdict(cfg),
             "replicas": args.replicas,
             "router": summary,
+            "speculate": spec_digest,
             "slo_rules": slo_digest,
             "anomaly_rules": anomaly_digest,
             "per_class": _class_tallies(done, cls_of),
@@ -1262,6 +1355,12 @@ def _run_serve(args) -> int:
     spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
                   num_heads=args.heads, num_layers=args.layers,
                   d_ff=args.d_ff)
+    spec_k, spec_method = 0, "ngram"
+    if args.speculate is not None:
+        try:
+            spec_k, spec_method = _parse_speculate(args.speculate)
+        except ValueError as e:
+            raise SystemExit(f"--speculate: {e}")
     cfg = ServeConfig(
         spec=spec,
         slots=args.slots,
@@ -1276,6 +1375,8 @@ def _run_serve(args) -> int:
         prefill_budget=args.prefill_budget,
         page_size=args.page_size,
         num_pages=args.num_pages,
+        speculate_k=spec_k,
+        speculate_method=spec_method,
     )
     if args.top_k and args.temperature <= 0:
         # Same flag hygiene as the variant-group rejects above: greedy
@@ -1298,6 +1399,37 @@ def _run_serve(args) -> int:
             "--max-replicas requires --autoscale (it caps the fleet "
             "the controller may grow; pass --autoscale '' for defaults)"
         )
+    # Disagg/speculation flag hygiene BOTH WAYS (ISSUE 15): each
+    # rejection names the offending combination — bare single-engine
+    # serve and contiguous engines reject the flags loudly instead of
+    # silently serving colocated/plain.
+    if args.roles is not None:
+        if args.replicas is None:
+            raise SystemExit(
+                f"--roles {args.roles} requires --replicas (roles "
+                "split the ROUTER's fleet by phase; bare single-engine "
+                "serve has no fleet to split)"
+            )
+        if args.page_size <= 0:
+            raise SystemExit(
+                f"--roles {args.roles} requires --page-size > 0 (the "
+                "prefill->decode hand-off moves KV pages; the "
+                "contiguous slot-ring layout has none)"
+            )
+    if args.speculate is not None:
+        if args.replicas is None:
+            raise SystemExit(
+                f"--speculate {args.speculate} requires --replicas "
+                "(speculative serving runs behind the router; bare "
+                "single-engine serve rejects the flag)"
+            )
+        if args.page_size <= 0:
+            raise SystemExit(
+                f"--speculate {args.speculate} requires --page-size > 0 "
+                "(draft lanes verify through block-table ALIASES of "
+                "the speculating slot's pages; the contiguous layout "
+                "has no pages to alias)"
+            )
     if args.replicas is not None:
         return _run_serve_router(args, cfg)
     if args.max_new_tokens < 1:

@@ -226,7 +226,7 @@ def build_report(records, top: int = 5) -> dict:
         {"kind": r["name"],
          "tick": r["attrs"].get("tick", r["attrs"].get("step")),
          **{k: r["attrs"][k]
-            for k in ("replica", "req", "src", "dst", "reason")
+            for k in ("replica", "req", "src", "dst", "reason", "pages")
             if k in r["attrs"]}}
         for r in records if r.get("name") in _FLEET_NAMES
     ]
@@ -283,7 +283,7 @@ def _print_report(rep: dict) -> None:
         for f in rep["fleet_incidents"]:
             who = " ".join(f"{k}={f[k]}"
                            for k in ("replica", "req", "src", "dst",
-                                     "reason") if k in f)
+                                     "reason", "pages") if k in f)
             _emit(f"  tick {f.get('tick')}: {f['kind']:<14} {who}")
     hits = {k: v for k, v in rep["incidents"].items() if v}
     if hits:

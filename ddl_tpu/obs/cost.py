@@ -201,6 +201,19 @@ def serve_decode_flops_per_token(spec, attend_width: int) -> int:
             + 2 * spec.d_model * spec.vocab)
 
 
+def serve_speculate_verify_flops(spec, fed_rows: int,
+                                 attend_width: int) -> int:
+    """One speculative verify call (ISSUE 15): ``fed_rows`` decode-
+    shaped rows — the real active slots PLUS every draft lane — each
+    attending ``attend_width`` resident rows. The verify is literally
+    the decode program with lanes riding in free slots, so its cost is
+    per-token decode cost times the rows actually computed; emitted
+    tokens can be fewer (rejected lanes) or more (a fully-accepted
+    block's bonus token) — the asymmetry IS the speculation trade, so
+    the accounting must price rows, not tokens."""
+    return fed_rows * serve_decode_flops_per_token(spec, attend_width)
+
+
 def serve_prefill_flops(spec, tokens: int, attend_width: int) -> int:
     """Prefill FLOPs for a ``tokens``-row block whose attention spans
     ``attend_width`` rows (the compiled bucket width — padding computes

@@ -31,8 +31,11 @@ Phase taxonomy (one vocabulary per kind, validated at ``add``):
   restore — the fault-tolerance tax, ISSUE 6).
 - ``serve``: ``prefill`` + ``decode`` (the goodput — device token
   work), ``prefix_copy`` (cache reuse copies), ``shed`` (shed/
-  deadline-eviction sweeps), ``idle`` (ticks with no device work),
-  ``host`` (non-idle tick residual: admission, telemetry, Python).
+  deadline-eviction sweeps), ``handoff`` (disaggregated
+  prefill->decode page transfers, ISSUE 15 — attributed to the SOURCE
+  replica's tracker by the fleet coordinator, outside any tick
+  bracket), ``idle`` (ticks with no device work), ``host`` (non-idle
+  tick residual: admission, telemetry, Python).
 
 Everything here is host arithmetic on brackets the loops ALREADY close
 (the ``StepTimer`` values, the compile/save brackets) — no new device
@@ -46,7 +49,8 @@ import time
 
 TRAIN_PHASES = ("compute", "staging", "compile", "eval", "checkpoint_io",
                 "stall")
-SERVE_PHASES = ("prefill", "decode", "prefix_copy", "shed", "idle", "host")
+SERVE_PHASES = ("prefill", "decode", "prefix_copy", "shed", "handoff",
+                "idle", "host")
 
 # The phases that count as goodput — useful device work — per kind.
 GOODPUT_PHASES = {
@@ -259,11 +263,26 @@ def fleet_summary(registry) -> dict:
             v = g.value()
             if v is not None:
                 out[key] = int(v)
-    c = registry.get("preemptions_total")
-    if c is not None and c.kind == "counter":
-        out["preemptions_total"] = int(sum(
-            c.value(**ls) for ls in c.label_sets()
-        ))
+    g = registry.get("fleet_replicas_active")
+    if g is not None and g.kind == "gauge":
+        # Per-role replica counts (ISSUE 15): the disagg coordinator /
+        # controller publish `fleet_replicas_active{role=}` next to the
+        # unlabeled total, so a role-starved fleet (prefill replicas
+        # with no decode replica to hand to) is visible at a glance.
+        by_role = {
+            ls["role"]: int(g.value(**ls))
+            for ls in g.label_sets()
+            if "role" in ls and g.value(**ls) is not None
+        }
+        if by_role:
+            out["replicas_by_role"] = by_role
+    for name, key in (("preemptions_total", "preemptions_total"),
+                      ("handoff_total", "handoffs_total")):
+        c = registry.get(name)
+        if c is not None and c.kind == "counter":
+            out[key] = int(sum(
+                c.value(**ls) for ls in c.label_sets()
+            ))
     return out
 
 

@@ -220,7 +220,7 @@ def trace_context(trace_dir: str | os.PathLike | None):
 # the analyze report's fleet-incident table reads this same tuple, so
 # the two surfaces cannot drift.
 FLEET_EVENTS = ("scale_out", "scale_in", "drain", "preempt", "resume",
-                "preempt_move", "replica_crash", "requeue")
+                "preempt_move", "replica_crash", "requeue", "handoff")
 
 INCIDENT_EVENTS = frozenset({
     "anomaly", "guard_skip", "guard_rollback", "shed", "router_shed",

@@ -13,9 +13,13 @@ batching — the inference half of the sharded-mesh story.
   of classed traffic over N scheduler/engine replicas (prefix-affinity
   placement, priority shedding, per-class SLO accounting)
 - ``serve.controller`` — the self-healing fleet controller: SLO/
-  pressure-driven autoscaling, drain-before-removal, replica-crash
-  recovery and cross-replica request preemption on the router's
-  deterministic global clock
+  pressure-driven autoscaling (per-role on disaggregated fleets),
+  drain-before-removal, replica-crash recovery and cross-replica
+  request preemption on the router's deterministic global clock
+- ``serve.disagg``    — disaggregated prefill/decode roles: phase-
+  specialized replicas with the first-token page hand-off coordinator
+- ``serve.speculate`` — speculative decoding drafts (n-gram / prompt
+  lookup) verified bit-identically through free decode-batch lanes
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
 
@@ -31,10 +35,18 @@ Quickstart (also ``python -m ddl_tpu serve --help``)::
 from .controller import (  # noqa: F401
     AutoscaleConfig,
     FleetController,
+    RoleScale,
     parse_autoscale_spec,
+)
+from .disagg import (  # noqa: F401
+    ROLES,
+    DisaggCoordinator,
+    parse_roles_spec,
+    validate_roles,
 )
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .prefix import PrefixIndex  # noqa: F401
+from .speculate import greedy_accept, propose_draft  # noqa: F401
 from .router import (  # noqa: F401
     ClassSpec,
     Router,
@@ -58,12 +70,15 @@ __all__ = [
     "AutoscaleConfig",
     "ClassSpec",
     "Completion",
+    "DisaggCoordinator",
     "FleetController",
     "InferenceEngine",
     "PreemptedRequest",
     "PrefixIndex",
     "Pressure",
+    "ROLES",
     "Request",
+    "RoleScale",
     "Router",
     "RouterConfig",
     "RouterStats",
@@ -71,8 +86,12 @@ __all__ = [
     "ServeConfig",
     "ServeStats",
     "derive_request_slo",
+    "greedy_accept",
     "parse_autoscale_spec",
+    "parse_roles_spec",
     "parse_slo_spec",
     "parse_traffic_spec",
+    "propose_draft",
     "request_slo_samples",
+    "validate_roles",
 ]

@@ -70,7 +70,46 @@ from __future__ import annotations
 
 import dataclasses
 
+from .disagg import ROLES
 from .scheduler import Completion
+
+
+@dataclasses.dataclass(frozen=True)
+class RoleScale:
+    """Per-role autoscale overrides (ISSUE 15): on a disaggregated
+    fleet each role scales off ITS OWN pressure — prefill replicas
+    saturate on prompt ingestion while decode replicas saturate on
+    resident tokens, and one shared threshold would always scale the
+    wrong phase first. ``None`` fields inherit the fleet-wide
+    :class:`AutoscaleConfig` value; ``min_replicas`` defaults to 1 for
+    every role present at bind (the both-sides invariant the router's
+    run loop depends on)."""
+
+    role: str
+    max_replicas: int | None = None
+    min_replicas: int | None = None
+    backlog_per_replica: float | None = None
+    sustain_ticks: int | None = None
+    idle_ticks: int | None = None
+
+    def __post_init__(self):
+        if self.role not in ROLES:
+            raise ValueError(
+                f"unknown role {self.role!r} (valid: {', '.join(ROLES)})"
+            )
+        for name in ("max_replicas", "min_replicas", "sustain_ticks",
+                     "idle_ticks"):
+            v = getattr(self, name)
+            if v is not None and v < 1:
+                raise ValueError(
+                    f"{self.role}.{name} must be >= 1, got {v}"
+                )
+        if self.backlog_per_replica is not None \
+                and self.backlog_per_replica <= 0:
+            raise ValueError(
+                f"{self.role}.backlog_per_replica must be > 0, got "
+                f"{self.backlog_per_replica}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,8 +145,22 @@ class AutoscaleConfig:
     # defer_door_shed=False (spec key ``defer=0``) to keep the static
     # door-shed behavior alongside the controller.
     defer_door_shed: bool = True
+    # Per-role overrides (ISSUE 15): one RoleScale per specialized role
+    # to scale independently. Empty on a mixed fleet — the config is
+    # byte-compatible with every pre-disagg caller.
+    roles: tuple[RoleScale, ...] = ()
+
+    def role_scale(self, role: str) -> RoleScale:
+        """The (possibly all-default) override record for ``role``."""
+        for rs in self.roles:
+            if rs.role == role:
+                return rs
+        return RoleScale(role)
 
     def __post_init__(self):
+        names = [rs.role for rs in self.roles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate role overrides in {names}")
         if self.min_replicas < 1:
             raise ValueError(
                 f"min_replicas must be >= 1, got {self.min_replicas}"
@@ -137,9 +190,13 @@ def parse_autoscale_spec(spec: str, *, max_replicas: int | None = None,
     ``min``, ``backlog`` (mean outstanding per replica), ``sustain``
     (ticks), ``idle`` (ticks before drain), ``preempt`` (0/1), ``wait``
     (preempt wait ticks), ``gap`` (priority gap), ``burn`` ('|'-joined
-    SLO rule names to watch). Example::
+    SLO rule names to watch). Per-role knobs (ISSUE 15, disaggregated
+    fleets) ride as ``ROLE.key=val`` with keys ``max``/``min``/
+    ``backlog``/``sustain``/``idle`` — each role then scales off its
+    own pressure signal. Example::
 
         backlog=3,sustain=2,idle=6,burn=bulk_shed
+        max=4,prefill.backlog=2,decode.backlog=4,decode.min=1
     """
     key_map = {
         "max": ("max_replicas", int),
@@ -155,7 +212,15 @@ def parse_autoscale_spec(spec: str, *, max_replicas: int | None = None,
         )),
         "defer": ("defer_door_shed", lambda v: bool(int(v))),
     }
+    role_key_map = {
+        "max": ("max_replicas", int),
+        "min": ("min_replicas", int),
+        "backlog": ("backlog_per_replica", float),
+        "sustain": ("sustain_ticks", int),
+        "idle": ("idle_ticks", int),
+    }
     kw: dict = {}
+    role_kw: dict[str, dict] = {}
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
@@ -166,6 +231,27 @@ def parse_autoscale_spec(spec: str, *, max_replicas: int | None = None,
             raise ValueError(
                 f"autoscale segment {part!r} needs key=val"
             )
+        if "." in key:
+            # Per-role knob: ROLE.key=val (ISSUE 15).
+            role, _, sub = key.partition(".")
+            if role not in ROLES:
+                raise ValueError(
+                    f"unknown role {role!r} in autoscale segment "
+                    f"{part!r} (valid: {', '.join(ROLES)})"
+                )
+            if sub not in role_key_map:
+                raise ValueError(
+                    f"unknown per-role autoscale key {sub!r} in "
+                    f"{part!r} (valid: {', '.join(role_key_map)})"
+                )
+            dest, conv = role_key_map[sub]
+            try:
+                role_kw.setdefault(role, {})[dest] = conv(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"autoscale segment {part!r}: bad value ({e})"
+                )
+            continue
         if key not in key_map:
             raise ValueError(
                 f"unknown autoscale key {key!r} "
@@ -178,6 +264,11 @@ def parse_autoscale_spec(spec: str, *, max_replicas: int | None = None,
             raise ValueError(
                 f"autoscale segment {part!r}: bad value ({e})"
             )
+    if role_kw:
+        kw["roles"] = tuple(
+            RoleScale(role, **fields)
+            for role, fields in sorted(role_kw.items())
+        )
     if max_replicas is not None:
         kw["max_replicas"] = max_replicas
     if "max_replicas" not in kw:
@@ -202,6 +293,7 @@ class FleetController:
         self.injector = injector
         self.router = None
         self._sustain = 0
+        self._role_sustain: dict[str, int] = {}
         self._idle: dict[int, int] = {}
         self._wait_since: dict[int, int] = {}
         self._moved: set[int] = set()
@@ -243,6 +335,27 @@ class FleetController:
                     f"autoscale burn rules {bad} are not among the "
                     f"monitor's rules ({sorted(known)})"
                 )
+        # Per-role overrides are validated HERE like burn rules: a
+        # RoleScale every consumer is gated off (all-mixed fleet, or a
+        # role the fleet never runs) would be a silently-never-firing
+        # knob — the operator believes a floor/threshold is in force.
+        if self.config.roles:
+            fleet_roles = set(router.roles)
+            if not any(r != "mixed" for r in fleet_roles):
+                raise ValueError(
+                    "autoscale per-role knobs "
+                    f"{[rs.role for rs in self.config.roles]} need a "
+                    "disaggregated fleet (--roles) — on an all-mixed "
+                    "fleet they would silently never apply"
+                )
+            bad = [rs.role for rs in self.config.roles
+                   if rs.role not in fleet_roles]
+            if bad:
+                raise ValueError(
+                    f"autoscale per-role knobs for {bad} name roles "
+                    f"the fleet does not run ({sorted(fleet_roles)}) — "
+                    "they would silently never apply"
+                )
         self.router = router
 
     def reset(self) -> None:
@@ -253,6 +366,7 @@ class FleetController:
         thing reset cannot restore — replicas removed or crashed in a
         previous run stay gone (their device state is gone)."""
         self._sustain = 0
+        self._role_sustain.clear()
         self._idle.clear()
         self._wait_since.clear()
         self._moved.clear()
@@ -304,6 +418,20 @@ class FleetController:
             if len(live) <= self.config.min_replicas:
                 break
             k = max(live)
+            if self._role_fleet():
+                # End-of-stream scale-in respects role floors too: the
+                # surplus candidates are replicas whose role is above
+                # its floor (highest id first, LIFO like the live
+                # path).
+                cands = [
+                    j for j in live
+                    if sum(1 for i in live
+                           if self._role_of(i) == self._role_of(j))
+                    > self._role_floor(self._role_of(j))
+                ]
+                if not cands:
+                    break
+                k = max(cands)
             if not r.scheds[k].idle:
                 break
             self._begin_drain(t, k)
@@ -329,6 +457,24 @@ class FleetController:
 
     def _routable(self) -> list[int]:
         return self.router.live_ids(routable=True)
+
+    # -- role fleet probes (ISSUE 15) ----------------------------------------
+
+    def _role_fleet(self) -> bool:
+        """True when the bound router runs specialized roles — the
+        per-role scale/heal/drain paths engage; an all-mixed fleet runs
+        the byte-identical pre-disagg controller."""
+        return any(r != "mixed" for r in self.router.roles)
+
+    def _role_of(self, k: int) -> str:
+        return self.router.roles[k]
+
+    def _role_floor(self, role: str) -> int:
+        """Scale-in/heal floor for one role: the explicit override, or
+        1 — a specialized fleet must keep both sides alive (the router
+        run loop's both-sides invariant)."""
+        v = self.config.role_scale(role).min_replicas
+        return v if v is not None else 1
 
     def _event(self, t: int, kind: str, **detail) -> None:
         self.events.append((t, kind, tuple(sorted(detail.items()))))
@@ -399,6 +545,40 @@ class FleetController:
         self._count("fleet_crashes_total")
 
     def _heal(self, t: int) -> None:
+        if self._role_fleet():
+            # Per-role floors (ISSUE 15): a crash must heal the PHASE
+            # it killed — replacing a dead decode replica with a mixed
+            # one would silently re-colocate the fleet. The role ledger
+            # covers dead entries too, so a role whose every replica
+            # crashed still heals.
+            for role in sorted(set(self.router.roles)):
+                floor = self._role_floor(role)
+                while sum(1 for k in self._live()
+                          if self._role_of(k) == role) < floor:
+                    k = self.router.add_replica(role)
+                    self.scale_outs += 1
+                    self.last_scale_tick = t
+                    self._event(t, "scale_out", replica=k, reason="heal",
+                                role=role)
+                    self._count("scale_events_total", kind="scale_out")
+            # The fleet-wide floor holds on role fleets too (scale-in
+            # already honors it on the way down — a crash must not be
+            # the one path that leaves the fleet below min_replicas):
+            # top up with the thinnest role, deterministically.
+            while len(self._live()) < self.config.min_replicas:
+                live = self._live()
+                role = min(
+                    sorted(set(self.router.roles)),
+                    key=lambda r: (sum(1 for k in live
+                                       if self._role_of(k) == r), r),
+                )
+                k = self.router.add_replica(role)
+                self.scale_outs += 1
+                self.last_scale_tick = t
+                self._event(t, "scale_out", replica=k, reason="heal",
+                            role=role)
+                self._count("scale_events_total", kind="scale_out")
+            return
         while len(self._live()) < self.config.min_replicas:
             k = self.router.add_replica()
             self.scale_outs += 1
@@ -409,6 +589,9 @@ class FleetController:
     # -- scale out ----------------------------------------------------------
 
     def _maybe_scale_out(self, t: int) -> None:
+        if self._role_fleet():
+            self._maybe_scale_out_role(t)
+            return
         live = self._routable()
         if not live:
             return
@@ -442,6 +625,69 @@ class FleetController:
                     reason="burn" if burn_hot else "pressure")
         self._count("scale_events_total", kind="scale_out")
 
+    def _maybe_scale_out_role(self, t: int) -> None:
+        """Role-aware scale-out (ISSUE 15): each role's mean
+        outstanding work is compared against ITS OWN threshold
+        (``RoleScale`` overrides, fleet defaults otherwise) with its
+        own sustain counter, and the hottest sustained role grows — at
+        most one replica per tick, capped by the fleet total AND the
+        role's own ``max``. A burn alert scales the hottest role (the
+        monitor cannot attribute a latency burn to a phase; backlog
+        can)."""
+        cfg = self.config
+        r = self.router
+        live = self._routable()
+        per_role: dict[str, list[int]] = {}
+        for k in live:
+            per_role.setdefault(self._role_of(k), []).append(k)
+        loads: dict[str, float] = {}
+        for role, ks in per_role.items():
+            backlog = 0
+            for k in ks:
+                p = r.scheds[k].pressure()
+                backlog += p.occupied_slots + p.pending_total
+            loads[role] = backlog / len(ks)
+            rs = cfg.role_scale(role)
+            thresh = (rs.backlog_per_replica
+                      if rs.backlog_per_replica is not None
+                      else cfg.backlog_per_replica)
+            if loads[role] >= thresh:
+                self._role_sustain[role] = \
+                    self._role_sustain.get(role, 0) + 1
+            else:
+                self._role_sustain[role] = 0
+        burn_hot = False
+        mon = r.slo_monitor
+        if mon is not None:
+            for name in cfg.burn_rules:
+                rule = next(rr for rr in mon.rules if rr.name == name)
+                if (mon.burn_rate(name, "fast") >= rule.threshold
+                        and mon.burn_rate(name, "slow") >= rule.threshold):
+                    burn_hot = True
+                    break
+        ready = []
+        for role in per_role:
+            rs = cfg.role_scale(role)
+            need = (rs.sustain_ticks if rs.sustain_ticks is not None
+                    else cfg.sustain_ticks)
+            if not (self._role_sustain.get(role, 0) >= need or burn_hot):
+                continue
+            if rs.max_replicas is not None and sum(
+                1 for k in self._live() if self._role_of(k) == role
+            ) >= rs.max_replicas:
+                continue
+            ready.append((-loads[role], role))
+        if not ready or len(self._live()) >= cfg.max_replicas:
+            return
+        role = min(ready)[1]
+        k = r.add_replica(role)
+        self.scale_outs += 1
+        self.last_scale_tick = t
+        self._role_sustain[role] = 0
+        self._event(t, "scale_out", replica=k, role=role,
+                    reason="burn" if burn_hot else "pressure")
+        self._count("scale_events_total", kind="scale_out")
+
     # -- scale in / drain ---------------------------------------------------
 
     def _maybe_scale_in(self, t: int) -> None:
@@ -452,6 +698,25 @@ class FleetController:
         for k in live:
             self._idle[k] = (self._idle.get(k, 0) + 1
                              if self.router.scheds[k].idle else 0)
+        if self._role_fleet():
+            # Role floors (ISSUE 15): a role drains only above ITS
+            # floor — the fleet must never drain its last decode
+            # replica because the prefill side happens to be busy.
+            ripe = []
+            for k in live:
+                role = self._role_of(k)
+                rs = self.config.role_scale(role)
+                need = (rs.idle_ticks if rs.idle_ticks is not None
+                        else self.config.idle_ticks)
+                if self._idle.get(k, 0) < need:
+                    continue
+                if sum(1 for j in live if self._role_of(j) == role) \
+                        <= self._role_floor(role):
+                    continue
+                ripe.append(k)
+            if ripe and len(live) > self.config.min_replicas:
+                self._begin_drain(t, max(ripe))
+            return
         if len(live) <= self.config.min_replicas:
             return
         ripe = [k for k in live
@@ -535,6 +800,10 @@ class FleetController:
             dests = []
             for k in live:
                 if k == src:
+                    continue
+                if self._role_fleet() and self._role_of(k) == "prefill":
+                    # A prefill specialist never decodes — adopting a
+                    # mid-decode victim there would park it forever.
                     continue
                 p = r.scheds[k].pressure()
                 # pending_total, not waiting_eligible: a freshly

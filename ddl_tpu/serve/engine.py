@@ -151,6 +151,17 @@ class ServeConfig:
     prefill_budget: int = 0  # prefill tokens per scheduler tick; 0 = all
     page_size: int = 0  # paged KV layout: rows per page; 0 = contiguous
     num_pages: int = 0  # paged pool size; 0 = slots * capacity / page_size
+    # Speculative decoding (ISSUE 15, serve.speculate): k > 0 drafts up
+    # to k tokens per active slot per tick and verifies them through
+    # FREE SLOTS of the one batched decode call (zero new programs —
+    # the draft lanes alias the speculating slot's pages). Greedy-
+    # accept needs the greedy target (temperature 0), the paged layout
+    # (lane tables are page aliases) and slots >= 2 (somewhere for a
+    # lane to ride). method: "ngram" (prompt + generated lookup) or
+    # "prompt" (prompt-only lookup). k = 0 is the byte-identical
+    # pre-speculation tick (HLO-pinned in tests/test_serve_speculate).
+    speculate_k: int = 0
+    speculate_method: str = "ngram"
 
     def dtype(self):
         return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
@@ -253,6 +264,41 @@ class InferenceEngine:
         if config.num_pages < 0:
             raise ValueError(f"num_pages must be >= 0, got {config.num_pages}")
         self.paged = ps > 0
+        # Speculation config (loud-ctor discipline): every requirement
+        # is structural — a violated one could only surface as silently
+        #-never-speculating or a mid-run lane failure.
+        sk = config.speculate_k
+        if sk < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {sk}")
+        from .speculate import SPECULATE_METHODS
+
+        if config.speculate_method not in SPECULATE_METHODS:
+            raise ValueError(
+                f"speculate_method must be one of "
+                f"{', '.join(SPECULATE_METHODS)}, got "
+                f"{config.speculate_method!r}"
+            )
+        if sk > 0:
+            if not self.paged:
+                raise ValueError(
+                    f"speculate_k={sk} needs the paged KV layout "
+                    "(page_size > 0): draft lanes verify through block-"
+                    "table ALIASES of the speculating slot's pages, and "
+                    "contiguous slot rings have no pages to alias"
+                )
+            if config.temperature > 0.0:
+                raise ValueError(
+                    f"speculate_k={sk} needs temperature=0 (greedy): "
+                    "greedy-accept is what keeps speculative output "
+                    "bit-identical to plain decode; sampled acceptance "
+                    "is a different algorithm"
+                )
+            if config.slots < 2:
+                raise ValueError(
+                    f"speculate_k={sk} needs slots >= 2: drafts verify "
+                    "through FREE slots of the batched decode, and a "
+                    "1-slot batch has no lane to ride"
+                )
         if self.paged:
             if config.capacity % ps:
                 raise ValueError(
@@ -830,6 +876,40 @@ class InferenceEngine:
             self.cache = fn(self.cache, jnp.int32(page), kk, vv, pp)
             mapped.append(page)
         return mapped
+
+    def alias_slot_pages(self, dst_slot: int, src_slot: int,
+                         rows: int) -> int:
+        """Make ``dst_slot`` a zero-copy alias of ``src_slot``'s table
+        covering logical rows ``[0, rows)`` — the draft-LANE setup of
+        speculative decoding (ISSUE 15, ``serve.speculate``): the lane
+        writes its draft token's K/V row through the SHARED pages and
+        attends the shared history, so one batched decode call verifies
+        k drafts with zero copies and zero new programs. Maps any page
+        ``src_slot`` still needs first (consuming ITS admission
+        reservation — the lane itself reserves nothing), then increfs
+        each page into the lane's table. The lane is torn down with the
+        ordinary ``release_slot`` (pure decref — the source's own
+        references keep every page live). Returns the aliased page
+        count."""
+        if not self.paged:
+            raise RuntimeError(
+                "alias_slot_pages needs the paged KV layout "
+                "(page_size > 0) — contiguous slots have no pages to "
+                "alias"
+            )
+        if int(self.table_len[dst_slot]) or int(self.reserved_for[dst_slot]):
+            raise RuntimeError(
+                f"alias_slot_pages into non-empty slot {dst_slot} "
+                "(lanes must be free slots)"
+            )
+        self._ensure_rows(src_slot, rows)
+        n = int(self.table_len[src_slot])
+        for i in range(n):
+            page = int(self.tables[src_slot, i])
+            self.pages.incref(page)
+            self.tables[dst_slot, i] = page
+        self.table_len[dst_slot] = n
+        return n
 
     def decode_page_bucket(self, pages: int) -> int:
         """The page-count bucket ladder: smallest power of two >=

@@ -67,6 +67,7 @@ import numpy as np
 
 from ..models import transformer
 from ..obs.trace import Tracer
+from .disagg import DisaggCoordinator, validate_roles
 from .engine import InferenceEngine, ServeConfig
 from .scheduler import (
     MIN_PREFIX_HIT,
@@ -134,6 +135,12 @@ class RouterConfig:
     eos_id: int | None = None
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
+    # Disaggregated prefill/decode roles (ISSUE 15, serve.disagg): one
+    # role per replica ("prefill"/"decode"/"mixed"); None = all mixed,
+    # the byte-identical pre-disaggregation fleet. A specialized fleet
+    # needs the paged layout (the hand-off moves KV pages) and both
+    # sides present (serve.disagg.validate_roles).
+    roles: tuple[str, ...] | None = None
 
 
 @dataclasses.dataclass
@@ -175,6 +182,9 @@ class RouterStats:
     ticks: int
     replica: list[ServeStats | None]
     fleet: dict | None = None
+    # Disaggregation digest (ISSUE 15): hand-off counts + per-role
+    # replica split; None on an all-mixed fleet.
+    disagg: dict | None = None
 
     @property
     def prefix_lookups(self) -> int:
@@ -216,6 +226,8 @@ class RouterStats:
             "prefix_hit_rate": round(self.prefix_hit_rate, 3),
             "ticks": self.ticks,
             **({"fleet": self.fleet} if self.fleet is not None else {}),
+            **({"disagg": self.disagg} if self.disagg is not None
+               else {}),
         }
 
 
@@ -287,6 +299,27 @@ class Router:
                     "land where nothing reads them. Build it on the "
                     "registry passed as registry="
                 )
+        # Role fleet (ISSUE 15): validated before any engine is built —
+        # a malformed split is a config error, never a mid-run hang.
+        if config.roles is not None:
+            if len(config.roles) != config.replicas:
+                raise ValueError(
+                    f"roles {tuple(config.roles)} names "
+                    f"{len(config.roles)} replicas but replicas="
+                    f"{config.replicas} — one role per replica"
+                )
+            validate_roles(config.roles)
+            if any(r != "mixed" for r in config.roles) \
+                    and config.serve.page_size <= 0:
+                raise ValueError(
+                    f"roles {tuple(config.roles)} need the paged KV "
+                    "layout (page_size > 0): the prefill->decode "
+                    "hand-off moves KV pages, and contiguous slot "
+                    "rings have none"
+                )
+        self.roles: list[str] = (list(config.roles)
+                                 if config.roles is not None
+                                 else ["mixed"] * config.replicas)
         self.config = config
         self.classes = {c.name: c for c in config.classes}
         self.tracer = tracer if tracer is not None else Tracer()
@@ -324,9 +357,14 @@ class Router:
                                        for _ in range(config.replicas)]
             regs = self.replica_registries
         self.scheds: list[Scheduler | None] = [
-            self._make_scheduler(eng, regs[k])
+            self._make_scheduler(eng, regs[k], role=self.roles[k])
             for k, eng in enumerate(self.engines)
         ]
+        # The hand-off coordinator exists only on a SPECIALIZED fleet —
+        # an all-mixed router runs the byte-identical pre-disagg loop
+        # (the transparency bar every fleet feature clears).
+        self.disagg = (DisaggCoordinator(self)
+                       if any(r != "mixed" for r in self.roles) else None)
         # Live SLO monitor (ISSUE 10): advanced once per GLOBAL tick in
         # run() — router-level rules read the router registry (validated
         # identical above, before the engines were built): counter-mode
@@ -374,14 +412,15 @@ class Router:
 
     # -- fleet surgery (ISSUE 13; driven by serve.controller) ---------------
 
-    def _make_scheduler(self, eng: InferenceEngine, reg) -> Scheduler:
+    def _make_scheduler(self, eng: InferenceEngine, reg, *,
+                        role: str = "mixed") -> Scheduler:
         cfg = self.config
         return Scheduler(
             eng, eos_id=cfg.eos_id, tracer=self.tracer,
             registry=reg, shed_threshold=cfg.shed_threshold,
             ttft_deadline_s=cfg.ttft_deadline_s,
             deadline_s=cfg.deadline_s, injector=self._injector,
-            peak_flops=self._peak_flops,
+            peak_flops=self._peak_flops, role=role,
         )
 
     def live_ids(self, *, routable: bool = False) -> list[int]:
@@ -397,16 +436,19 @@ class Router:
         controller's preemption ordering."""
         return self.classes[req.traffic_class].priority
 
-    def add_replica(self) -> int:
+    def add_replica(self, role: str = "mixed") -> int:
         """Scale out: a new replica sharing the fleet's placed params
         (no second placement), its program ladder warmed OFF the timed
         path when the router was warmed, armed mid-run so it can
-        receive the very next routed arrival. Returns the replica
-        id."""
+        receive the very next routed arrival. ``role`` specializes the
+        newcomer on a disaggregated fleet (ISSUE 15 — the role-aware
+        controller scales each phase off its own pressure). Returns
+        the replica id."""
         k = len(self.engines)
         eng = InferenceEngine(self.config.serve,
                               placed_params=self._placed_params)
         self.engines.append(eng)
+        self.roles.append(role)
         reg = None
         if self.replica_registries is not None:
             # Parity with the ctor: one per-replica serve_* registry
@@ -417,7 +459,7 @@ class Router:
 
             reg = MetricRegistry()
             self.replica_registries.append(reg)
-        sched = self._make_scheduler(eng, reg)
+        sched = self._make_scheduler(eng, reg, role=role)
         self.scheds.append(sched)
         if self._warm_items is not None:
             # warmup suppresses its own telemetry (Scheduler.warmup),
@@ -535,6 +577,8 @@ class Router:
         self.draining.clear()
         if self.controller is not None:
             self.controller.reset()
+        if self.disagg is not None:
+            self.disagg.reset()
 
     def warmup(self, items) -> None:
         """Compile every replica's program ladder for ``items`` outside
@@ -634,7 +678,11 @@ class Router:
             self.registry.counter("router_requests_total").inc(
                 **{"class": cls.name}
             )
-        cand = self.live_ids(routable=True)
+        # Arrivals land only on PREFILL-CAPABLE replicas (ISSUE 15):
+        # decode-role replicas receive work exclusively through the
+        # coordinator's page hand-off. All-mixed fleets filter nothing.
+        cand = [k for k in self.live_ids(routable=True)
+                if self.roles[k] != "decode"]
         if not cand:
             # No routable replica this tick (a crash mid-heal, or the
             # whole fleet draining): wait at the door — the controller
@@ -773,6 +821,13 @@ class Router:
                     i += 1
                 if ctrl is not None:
                     ctrl.after_route(t)
+                if self.disagg is not None:
+                    # Hand held first-token prefixes to decode replicas
+                    # BEFORE replicas tick: the adoptee decodes this
+                    # very tick. Deterministic host state only — the
+                    # seeded stream hands off at identical ticks.
+                    self.disagg.transfer(t)
+                    self.disagg.publish()
                 for k, sched in enumerate(self.scheds):
                     if sched is not None and not sched.idle:
                         sched.tick()
@@ -914,6 +969,19 @@ class Router:
             replica=list(replica_stats),
             fleet=(self.controller.summary()
                    if self.controller is not None else None),
+            disagg=(
+                {
+                    **self.disagg.summary(),
+                    "roles": {
+                        role: sum(
+                            1 for k in self.live_ids()
+                            if self.roles[k] == role
+                        )
+                        for role in sorted(set(self.roles))
+                    },
+                }
+                if self.disagg is not None else None
+            ),
         )
 
 

@@ -105,6 +105,18 @@ step_time / itl / mfu / queue_depth / active_slots / occupied_slots /
 pages_free; the host-state signals are deterministic functions of the
 tick clock, which is what pins the stall-injection scenario's anomaly
 to identical ticks across runs (tests/test_goodput.py).
+
+Disaggregation & speculation (ISSUE 15): ``role="prefill"`` makes this
+scheduler a prompt-ingestion specialist — the decode phase is skipped
+wholesale and first-token slots are HELD for the fleet coordinator's
+page hand-off (``serve.disagg``; the preempt/adopt machinery below is
+the transfer). ``ServeConfig.speculate_k > 0`` replaces the plain
+decode phase with :meth:`_speculate_decode`: still exactly one batched
+decode call per tick, but free slots become draft LANES verifying
+n-gram-lookup proposals (``serve.speculate``) — greedy-accept keeps the
+output BIT-IDENTICAL to plain decode while emitting up to k+1 tokens
+per target step. Both default off; the off paths are byte-identical to
+the pre-ISSUE-15 tick.
 """
 
 from __future__ import annotations
@@ -121,6 +133,7 @@ from ..obs.memory import MemorySampler, record_compile
 from ..obs.trace import NULL_TRACER
 from ..utils.metrics import StepStats, StepTimer
 from .engine import InferenceEngine
+from .speculate import greedy_accept, propose_draft
 
 # A prefix hit shorter than this prefills normally: every BOS-led prompt
 # trivially shares its first token with every cached entry, and a
@@ -334,9 +347,29 @@ class Scheduler:
                  deadline_s: float | None = None,
                  shed_threshold: int | None = None, injector=None,
                  slo_monitor=None, peak_flops: float | None = None,
-                 anomaly_detector=None):
+                 anomaly_detector=None, role: str = "mixed"):
         self.engine = engine
         self.eos_id = eos_id
+        # Disaggregated serving (ISSUE 15, serve.disagg): a "prefill"-
+        # role scheduler runs prompts to their first token and then
+        # HOLDS the slot — the decode phase is skipped wholesale, and
+        # the fleet coordinator lifts the finished prefix out with the
+        # ordinary preempt/adopt page hand-off. "decode" replicas
+        # behave exactly like "mixed" (the split is enforced by the
+        # router's placement, not here); "mixed" is the default and the
+        # byte-identical pre-disaggregation tick.
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'mixed', 'prefill' or 'decode', got "
+                f"{role!r}"
+            )
+        if role == "prefill" and not engine.paged:
+            raise ValueError(
+                "role='prefill' needs the paged KV layout (page_size > "
+                "0): the prefill->decode hand-off moves KV pages, and "
+                "contiguous slot rings have none"
+            )
+        self.role = role
         if allow_window and engine.paged:
             raise ValueError(
                 "allow_window is a ring-buffer (contiguous) semantics — "
@@ -494,11 +527,17 @@ class Scheduler:
         saved = (self.tracer, self.registry, self.metrics_writer,
                  self.ttft_deadline_s, self.deadline_s,
                  self.shed_threshold, self.injector, self.slo_monitor,
-                 self._mem, self.anomaly, self._goodput)
+                 self._mem, self.anomaly, self._goodput, self.role)
         self.tracer, self.registry, self.metrics_writer = \
             NULL_TRACER, None, None
         self.ttft_deadline_s = self.deadline_s = None
         self.shed_threshold = self.injector = None
+        # A prefill-role scheduler HOLDS first-token slots for the
+        # fleet coordinator — warmup has no coordinator, so the clone
+        # run warms as "mixed" (which also compiles the decode ladder
+        # this replica needs if the controller ever re-roles traffic
+        # through it).
+        self.role = "mixed"
         # The SLO monitor, memory sampler, anomaly detector and goodput
         # tracker are per-TICK consumers: warmup's clone ticks must not
         # advance burn-rate/baseline windows, sample watermarks or
@@ -601,7 +640,7 @@ class Scheduler:
             (self.tracer, self.registry, self.metrics_writer,
              self.ttft_deadline_s, self.deadline_s,
              self.shed_threshold, self.injector, self.slo_monitor,
-             self._mem, self.anomaly, self._goodput) = saved
+             self._mem, self.anomaly, self._goodput, self.role) = saved
 
     def _validate(self, r: Request) -> None:
         """Reject a malformed request at SUBMIT time — ``run`` validates
@@ -1086,6 +1125,162 @@ class Scheduler:
         return (len(st.generated[s]) >= st.occupant[s].max_new_tokens
                 or (self.eos_id is not None and token == self.eos_id))
 
+    def _speculate_decode(self, st: _RunState, step: int):
+        """The speculative decode phase (ISSUE 15, ``serve.speculate``):
+        still exactly ONE batched decode call per tick — the same
+        compiled program the plain path runs — but FREE slots become
+        draft LANES: lane ``i`` aliases the speculating slot's pages
+        (``engine.alias_slot_pages``, incref only), feeds draft token
+        ``i`` at position ``n + 1 + i``, and its returned sample IS the
+        target model's greedy token for that position (the decode
+        program's per-slot math is row-independent — the continuous-
+        batching determinism pin — so every lane row is bitwise the
+        sequential step's). Greedy-accept keeps the longest matching
+        draft prefix plus the first mismatch (the true next token), so
+        output is BIT-IDENTICAL to plain decode; rejected lanes leave
+        rows only BEYOND the new frontier (position-masked invisible,
+        overwritten by the next step that reaches them). Returns
+        ``(decode_s, itl_s, mfu_val)`` for the tick's anomaly feed."""
+        eng = self.engine
+        cfg = eng.config
+        S = cfg.slots
+        tr = self.tracer
+        reg = self.registry
+        gp = self._goodput
+        k = cfg.speculate_k
+        last = st.last_tokens.copy()
+        lengths = st.lengths.copy()
+        req_ids = st.req_ids.copy()
+        active = st.active.copy()
+        free = [s for s in range(S) if st.occupant[s] is None]
+        lanes_of: dict[int, tuple[list[int], np.ndarray]] = {}
+        proposed = 0
+        for s in range(S):
+            if not st.active[s] or not free:
+                continue
+            r = st.occupant[s]
+            remaining = r.max_new_tokens - len(st.generated[s])
+            if remaining < 2:
+                # One token to go: a draft could only propose tokens
+                # the budget forbids emitting.
+                continue
+            prompt = np.asarray(r.prompt, np.int32)
+            ctx = np.concatenate(
+                [prompt, np.asarray(st.generated[s], np.int32)]
+            )
+            draft = propose_draft(
+                ctx, min(k, remaining - 1, len(free)),
+                method=cfg.speculate_method,
+                prompt_len=int(prompt.shape[0]),
+            )
+            if not draft.size:
+                continue  # no lookup hit: this slot rides plain
+            n = int(st.lengths[s])
+            lanes = free[: draft.size]
+            del free[: draft.size]
+            for i, lane in enumerate(lanes):
+                eng.alias_slot_pages(lane, s, n + int(draft.size) + 1)
+                active[lane] = True
+                last[lane] = int(draft[i])
+                lengths[lane] = n + 1 + i
+                req_ids[lane] = r.id
+            lanes_of[s] = (lanes, draft)
+            proposed += int(draft.size)
+        n_active = int(st.active.sum())
+        n_lanes = sum(len(lanes) for lanes, _ in lanes_of.values())
+        # Computed BEFORE finishes mutate occupancy — the decode_tick
+        # `reqs` attribute lists the REAL slots that decoded, exactly
+        # as the plain path does (lanes are compute, not requests).
+        reqs_now = [int(st.req_ids[i]) for i in range(S) if st.active[i]]
+        t0 = time.perf_counter()
+        nxt, _ = eng.decode(last, lengths, req_ids, active)
+        now = time.perf_counter()
+        dt = now - t0
+        # Lane teardown is pure decref (the source slot's own refs keep
+        # every page live) — done before bookkeeping so no later raise
+        # can leak an aliased table.
+        for lanes, _ in lanes_of.values():
+            for lane in lanes:
+                eng.release_slot(lane)
+        chained = st.last_decode_done is not None
+        itl_s = None
+        if chained:
+            st.itls.append(now - st.last_decode_done)
+            itl_s = st.itls[-1]
+        st.last_decode_done = now
+        emitted_total = 0
+        accepted_total = 0
+        for s in range(S):
+            if not st.active[s]:
+                continue
+            lanes, draft = lanes_of.get(s, (None, None))
+            if lanes is None:
+                # No draft for this slot: its own decode row advanced
+                # it exactly one token, the plain way.
+                st.lengths[s] += 1
+                tok = int(nxt[s])
+                st.generated[s].append(tok)
+                st.last_tokens[s] = tok
+                emitted_total += 1
+                if self._finished(st, s, tok):
+                    self._finish(st, s)
+                continue
+            # verified[0] is the slot's own next token, verified[1 + i]
+            # lane i's — the model's greedy answer at each position.
+            verified = [int(nxt[s])] + [int(nxt[lane]) for lane in lanes]
+            a = greedy_accept(draft, verified)
+            emitted = 0
+            for tok in verified[: a + 1]:
+                st.lengths[s] += 1
+                st.generated[s].append(tok)
+                st.last_tokens[s] = tok
+                emitted += 1
+                if self._finished(st, s, tok):
+                    self._finish(st, s)
+                    break  # eos/budget truncates the rest of the block
+            emitted_total += emitted
+            # Only drafts actually EMITTED count as accepted (a draft
+            # "matching" past an eos was never served).
+            accepted_total += min(a, emitted)
+        st.decode_timer.add(dt, images=emitted_total)
+        decode_s = dt
+        if gp is not None:
+            gp.add("decode", dt)
+        if tr:
+            tr.complete("decode_tick", t0, now, step=step,
+                        n_active=n_active, chained=chained,
+                        reqs=reqs_now, spec_lanes=n_lanes,
+                        spec_emitted=emitted_total)
+        mfu_val = None
+        if reg is not None:
+            reg.counter("serve_decode_tokens_total").inc(emitted_total)
+            reg.histogram("serve_decode_step_seconds").observe(dt)
+            if chained:
+                reg.histogram("serve_itl_seconds").observe(st.itls[-1])
+            if proposed:
+                # The measured acceptance ledger (ISSUE 15): accepted /
+                # proposed is the rate that says whether k paid.
+                reg.counter("speculate_proposed_total").inc(proposed)
+                reg.counter("speculate_accepted_total").inc(
+                    accepted_total
+                )
+            fpt = _cost.serve_decode_flops_per_token(
+                cfg.spec, eng.last_attend_width
+            )
+            reg.gauge("serve_flops_per_token").set(fpt)
+            # Honest verify accounting (obs.cost): lanes COMPUTE at the
+            # attended width whether or not their draft is accepted —
+            # the MFU numerator prices real + lane rows, while the
+            # token counters above carry only what was emitted.
+            mfu_val = _cost.mfu(
+                _cost.serve_speculate_verify_flops(
+                    cfg.spec, n_active + n_lanes, eng.last_attend_width
+                ),
+                dt, int(eng.mesh.devices.size), self._resolve_peak(),
+            )
+            reg.gauge("serve_mfu").set(mfu_val)
+        return decode_s, itl_s, mfu_val
+
     def tick(self) -> None:
         """One scheduler step of the armed run: stamp eligibility /
         shed / expire, admit into free slots, prefill under the chunk
@@ -1368,7 +1563,17 @@ class Scheduler:
                     if self._finished(st, s, tok):
                         self._finish(st, s)
                     break
-        if st.active.any():
+        if st.active.any() and self.role == "prefill":
+            # Disaggregated prefill role (ISSUE 15): first-token slots
+            # are HELD for the fleet coordinator's page hand-off — this
+            # replica never runs the decode program at all (it stays
+            # the matmul-bound full-width-prefill specialist). A held
+            # tick makes no device calls; the decode-side ITL chain is
+            # someone else's story.
+            st.last_decode_done = None
+        elif st.active.any() and self.engine.config.speculate_k:
+            decode_s, itl_s, mfu_val = self._speculate_decode(st, step)
+        elif st.active.any():
             n_active = int(st.active.sum())
             t0 = time.perf_counter() if tr else 0.0
             with st.decode_timer.step(images=n_active):

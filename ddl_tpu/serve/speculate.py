@@ -1,0 +1,140 @@
+"""Speculative decoding: n-gram / prompt-lookup drafts verified in ONE
+target-model step, bit-identical to plain greedy decode (ISSUE 15
+tentpole piece 2).
+
+Decode emits one token per compiled step per slot — the bandwidth-bound
+phase ``obs/cost.py`` accounts at the attended width. Speculative
+decoding multiplies tokens-per-step: a cheap DRAFT proposes the next k
+tokens and the target model verifies all of them in one step, emitting
+every draft token that matches what it would have produced anyway plus
+one free correction/bonus token. With a greedy target (temperature 0)
+and greedy acceptance the output is EXACTLY plain decode's — the only
+thing speculation changes is how many compiled steps it takes to say it.
+
+**The draft** (this module — pure host code, no device work): prompt-
+lookup / n-gram matching (the Saxena prompt-lookup trick; PAPERS.md
+2605.25645 frames the serving economics). The longest suffix n-gram of
+the known context (``prompt + generated``, methods ``"ngram"``; prompt
+only, ``"prompt"``) is searched for its RIGHTMOST earlier occurrence,
+and the tokens that followed it become the draft. Greedy decode of a
+looping/templated stream revisits its own n-grams constantly — exactly
+the workload the drafts nail.
+
+**The verify** (``serve.scheduler._speculate_decode``): the ISSUE-15
+sketch verified drafts with a short prefill block over the resident
+pages. Measured on this backend, a prefill-program row is NOT bitwise
+equal to the decode program's row for the same context (~1e-6 — two
+compiled programs, two reduction orders), and bit-identity is the
+acceptance bar. What IS bitwise-identical by construction is the decode
+program against itself: its per-slot math is row-independent (the
+continuous-batching determinism contract, pinned in tests/test_serve.py
+— a slot's logits do not depend on what the other slots compute). So
+the verify step feeds the drafts through FREE SLOTS of the ONE batched
+decode call the tick was already going to make:
+
+- draft lane ``i`` aliases the speculating slot's block table
+  (``engine.alias_slot_pages`` — incref, zero copy; the paged pool
+  already refcounts pages across slots and prefix entries), feeds draft
+  token ``d_i`` at position ``n + i``, and writes its K/V row through
+  the shared pages;
+- the decode program writes every lane's row BEFORE attending (the
+  cache discipline), and attention masks on position (``pos > q_pos``
+  is invisible), so lane ``i`` attends exactly the history a sequential
+  decode at position ``n + i`` would — its logits row is the SAME
+  program computing the SAME math, bitwise equal to the sequential
+  step's (pinned at tp=1 AND tp=2 in tests/test_serve_speculate.py);
+- acceptance is host arithmetic on the returned per-lane argmax tokens:
+  the longest prefix of drafts matching what the model itself produced,
+  plus the first mismatch as the correction (it IS the true greedy
+  token). Rejected lanes leave stale rows at positions BEYOND the new
+  frontier — never attendable (position masking) and overwritten by the
+  very next step that reaches them (writes advance contiguously and the
+  cache writes before it attends).
+
+One decode call per tick, same compiled program, no new shapes: the
+page-count bucket ladder is untouched and ``speculate_k=0`` runs the
+byte-identical pre-speculation tick (Python branch — HLO-text pinned).
+Free slots were ALREADY computing (fixed shapes); speculation just makes
+them compute something useful — which is also why k can hurt: at full
+occupancy there are no lanes and speculation silently degrades to plain
+decode, and every rejected lane was attended-width compute bought for
+nothing (``obs.cost.serve_speculate_verify_flops`` prices it;
+``speculate_accepted_total / speculate_proposed_total`` is the measured
+acceptance rate that says whether k paid).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Longest suffix n-gram tried for a lookup match, longest first — a
+# 3-gram hit is much stronger evidence of a repeating span than a
+# 1-gram, and the cascade keeps the draft non-empty whenever ANY suffix
+# token reoccurs.
+NGRAM_MAX = 3
+
+SPECULATE_METHODS = ("ngram", "prompt")
+
+
+def propose_draft(context, k: int, *, method: str = "ngram",
+                  prompt_len: int | None = None,
+                  max_ngram: int = NGRAM_MAX) -> np.ndarray:
+    """Up to ``k`` draft tokens continuing ``context`` (int32, the
+    KNOWN tokens: prompt plus everything generated so far, the sampled-
+    but-unappended last token included).
+
+    For ``n = max_ngram .. 1``, the context's last ``n`` tokens are
+    searched for their RIGHTMOST earlier occurrence in the match source
+    — the whole context for ``"ngram"``, only ``context[:prompt_len]``
+    for ``"prompt"`` (classic prompt-lookup: the generation is expected
+    to quote the document) — and the tokens following the match become
+    the draft, truncated to ``k`` and to what the source holds. Empty
+    array when nothing matches (the caller falls back to plain decode
+    for that slot, proposing nothing). Deterministic: same context,
+    same draft, everywhere — the speculation path inherits the serving
+    determinism contract for free."""
+    if k < 1:
+        return np.zeros(0, np.int32)
+    if method not in SPECULATE_METHODS:
+        raise ValueError(
+            f"unknown speculate method {method!r} "
+            f"(valid: {', '.join(SPECULATE_METHODS)})"
+        )
+    ctx = np.asarray(context, np.int32)
+    c = int(ctx.shape[0])
+    if method == "prompt":
+        if prompt_len is None:
+            raise ValueError("method 'prompt' needs prompt_len")
+        src = ctx[:prompt_len]
+    else:
+        src = ctx
+    m = int(src.shape[0])
+    for n in range(min(max_ngram, c - 1, m - 1), 0, -1):
+        suffix = ctx[c - n:]
+        # Rightmost earlier occurrence with at least one continuation
+        # token. `j + n < c` excludes the suffix matching itself in the
+        # "ngram" source; for "prompt" the source is already clipped.
+        limit = min(m - n, c - n)
+        for j in range(limit - 1, -1, -1):
+            if np.array_equal(src[j:j + n], suffix):
+                draft = src[j + n: j + n + k]
+                if draft.size:
+                    return np.asarray(draft, np.int32)
+    return np.zeros(0, np.int32)
+
+
+def greedy_accept(drafts, verified) -> int:
+    """Longest accepted-draft prefix: ``drafts[i]`` is accepted iff it
+    equals ``verified[i]`` — the token the target model itself produced
+    at that position (``verified`` has one MORE entry than ``drafts``:
+    the speculating slot's own next token first, then one per lane).
+    Pure arithmetic, split out so the acceptance rule is testable
+    without a device."""
+    a = 0
+    while a < len(drafts) and int(drafts[a]) == int(verified[a]):
+        a += 1
+    return a
+
+
+__all__ = ["propose_draft", "greedy_accept", "NGRAM_MAX",
+           "SPECULATE_METHODS"]

@@ -110,6 +110,14 @@ class StepTimer:
         self._times.append(time.perf_counter() - t0)
         self._images.append(images if images is not None else (self.batch_size or 0))
 
+    def add(self, seconds: float, images: int = 0) -> None:
+        """Record one externally-bracketed step. The ``step()`` context
+        needs ``images`` up front; the serve scheduler's speculative
+        decode (ISSUE 15) learns its emitted-token count only AFTER the
+        call returns — same list appends, same stats."""
+        self._times.append(float(seconds))
+        self._images.append(int(images))
+
     @property
     def total_s(self) -> float:
         """Total timed seconds, warmup included (throughput accounting)."""

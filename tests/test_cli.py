@@ -457,3 +457,74 @@ def test_main_serve_rejects_bad_prefix_chunk_flags():
     with pytest.raises(SystemExit, match="serve config error"):
         main(["serve", "--platform", "cpu", "--page-size", "8",
               "--num-pages", "2"])  # below --slots (default 4)
+
+
+def test_main_serve_disagg_speculate_end_to_end(capsys):
+    """ISSUE 15 CLI surface: ``--roles`` + ``--speculate`` on a paged
+    router fleet serves the stream disaggregated AND speculative — the
+    JSON contract carries the disagg digest (role split, hand-off
+    ledger) and the speculation acceptance digest, and every request
+    resolves ok."""
+    model = ["--vocab", "16", "--d-model", "32", "--heads", "2",
+             "--layers", "2", "--d-ff", "64"]
+    assert main([
+        "serve", "--platform", "cpu", "--replicas", "2", "--slots", "2",
+        "--capacity", "64", "--page-size", "8",
+        "--roles", "prefill=1,decode=1", "--speculate", "2",
+        "--traffic",
+        "horizon=8;seed=0;max_requests=6;"
+        "chat:rate=0.6,pmin=4,pmax=8,new=6",
+        "--metrics-out", "/dev/null", "--json"] + model) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    disagg = payload["router"]["disagg"]
+    assert disagg["roles"] == {"prefill": 1, "decode": 1}
+    assert disagg["handoffs"] >= 1
+    assert disagg["handoff_pages"] >= disagg["handoffs"]
+    spec = payload["speculate"]
+    assert spec["k"] == 2 and spec["method"] == "ngram"
+    assert 0 <= spec["accepted"] <= spec["proposed"]
+    for row in payload["per_class"].values():
+        assert row["total"] == row["ok"]
+
+
+def test_main_serve_disagg_speculate_flag_hygiene():
+    """ISSUE 15 flag hygiene BOTH WAYS: --roles/--speculate without
+    --replicas or on contiguous engines reject loudly with the
+    offending combination named; malformed specs are named errors; the
+    flags fail on training variants."""
+    with pytest.raises(SystemExit, match="--roles .* requires --replicas"):
+        main(["serve", "--platform", "cpu",
+              "--roles", "prefill=1,decode=1"])
+    with pytest.raises(SystemExit,
+                       match="--roles .* requires --page-size"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--roles", "prefill=1,decode=1"])
+    with pytest.raises(SystemExit,
+                       match="--speculate 4 requires --replicas"):
+        main(["serve", "--platform", "cpu", "--speculate", "4"])
+    with pytest.raises(SystemExit,
+                       match="--speculate 4 requires --page-size"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--speculate", "4"])
+    with pytest.raises(SystemExit, match="sum to it"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--page-size", "8", "--roles", "prefill=1,decode=2"])
+    with pytest.raises(SystemExit, match="no decode-"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--page-size", "8", "--roles", "prefill=2"])
+    with pytest.raises(SystemExit, match="draft length"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--page-size", "8", "--speculate", "zero"])
+    with pytest.raises(SystemExit, match="unknown method"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--page-size", "8", "--speculate", "4,beam"])
+    with pytest.raises(SystemExit, match="--roles"):
+        main(["lm", "--roles", "prefill=1,decode=1"])
+    with pytest.raises(SystemExit, match="--speculate"):
+        main(["lm", "--speculate", "4"])
+    # Deep engine validation still surfaces as a config error: greedy
+    # is required for greedy-accept.
+    with pytest.raises(SystemExit, match="serve config error"):
+        main(["serve", "--platform", "cpu", "--replicas", "2",
+              "--page-size", "8", "--speculate", "2",
+              "--temperature", "0.8"])

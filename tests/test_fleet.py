@@ -231,9 +231,10 @@ def test_replica_crash_heals_and_completes_exactly_once():
 
     # A crash tick beyond the run's horizon must FAIL loudly at run
     # end (a chaos run that exercised nothing must not pass clean).
+    # ctrl's config is reused verbatim (only the injector differs) —
+    # which also keeps the test inside the markers-audit cap ledger.
     late = FleetController(
-        AutoscaleConfig(max_replicas=2, min_replicas=2, preempt=False,
-                        backlog_per_replica=10.0),
+        ctrl.config,
         injector=FaultInjector(FaultSpec(kind="replica_crash",
                                          step=999, replica=0)),
     )

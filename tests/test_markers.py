@@ -23,7 +23,13 @@ Bounds (per test function, per run):
   ``max_new_tokens`` is the largest resolvable int literal passed under
   that keyword to a ``Request(...)`` or a ``dict(...)`` (the mixed-
   traffic class-spec shape). Code inside ``pytest.raises`` blocks is
-  excluded (a rejected request generates nothing).
+  excluded (a rejected request generates nothing). Speculative
+  decoding (ISSUE 15): the largest ``speculate_k=`` literal ADDS to
+  the per-request cost (every verify step computes up to k draft-lane
+  rows beyond the token it emits), so the budget reads
+  ``requests * (max_new + speculate_k)``; a ``roles=`` keyword
+  anywhere marks the test scheduler-driving (disaggregated fleets
+  drive schedulers through the router/coordinator surface).
 - **> 2 topologies** — the product of literal tuple/list lengths over
   ``for`` loops whose bodies construct ``ServeConfig`` /
   ``InferenceEngine`` (each iteration compiles a fresh engine), AND at
@@ -116,6 +122,7 @@ def estimate(fn) -> tuple[bool, int, int]:
     prompt_set = 0
     request_sites = 0
     max_new = 0
+    spec_k = 0
     topologies = 1
     router_replicas = 0
     fleet_caps = 0
@@ -145,6 +152,15 @@ def estimate(fn) -> tuple[bool, int, int]:
                 topologies *= max(1, len(node.iter.elts))
         if not isinstance(node, ast.Call):
             continue
+        # ISSUE 15 extension: roles= marks scheduler-driving wherever
+        # it appears; speculate_k= literals feed the token budget.
+        for kw in node.keywords:
+            if kw.arg == "roles":
+                uses_scheduler = True
+            elif kw.arg == "speculate_k":
+                v = _const_int(kw.value)
+                if v is not None:
+                    spec_k = max(spec_k, v)
         name = _call_name(node)
         if name in ("Request", "dict"):
             # dict() covers the mixed-traffic class specs — their
@@ -181,7 +197,7 @@ def estimate(fn) -> tuple[bool, int, int]:
             v = _kw_int(node, "max_requests")
             if v is not None:
                 prompt_set = max(prompt_set, v)
-    tokens = max(prompt_set, request_sites) * max_new
+    tokens = max(prompt_set, request_sites) * (max_new + spec_k)
     return uses_scheduler, tokens, max(topologies, router_replicas,
                                        fleet_caps)
 
@@ -461,6 +477,49 @@ def test_fleet_audit_estimator_extension():
     assert uses and tokens == 8 and topo == 2
     uses, tokens, topo = estimate(fns["test_autoscaler_name_marks"])
     assert uses and tokens == 0 and topo == 1
+
+
+def test_speculate_roles_audit_estimator_extension():
+    """ISSUE 15 self-pin: ``speculate_k=`` literals ADD to the
+    generated-token budget (each verify step computes up to k draft-
+    lane rows beyond the token it emits), and a ``roles=`` keyword
+    alone marks a test scheduler-driving — so disagg/speculation tests
+    flag exactly like direct Scheduler/Router tests, while an in-budget
+    speculative test stays exempt-by-budget."""
+    src = textwrap.dedent("""
+        def test_speculate_token_overrun():
+            cfg = ServeConfig(page_size=8, speculate_k=4)
+            prompts = synthesize_prompts(num=10, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=8)
+                    for i, p in enumerate(prompts)]
+            Scheduler(InferenceEngine(cfg)).run(reqs)
+
+        def test_speculate_in_budget():
+            cfg = ServeConfig(page_size=8, speculate_k=2)
+            prompts = synthesize_prompts(num=4, min_len=4, max_len=8)
+            reqs = [Request(id=i, prompt=p, max_new_tokens=6)
+                    for i, p in enumerate(prompts)]
+            Scheduler(InferenceEngine(cfg)).run(reqs)
+
+        def test_roles_marks_scheduler_driving():
+            rcfg = RouterConfig(serve=ServeConfig(page_size=8),
+                                replicas=3,
+                                roles=("prefill", "decode", "decode"))
+            drive(rcfg)
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_speculate_token_overrun",
+                     "test_roles_marks_scheduler_driving"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_speculate_token_overrun"])
+    assert uses and tokens == 120 and topo == 1  # 10 * (8 + 4)
+    uses, tokens, _ = estimate(fns["test_speculate_in_budget"])
+    assert uses and tokens == 32  # 4 * (6 + 2)
+    # roles= alone marks the test, and the replicas literal still sums
+    # into the topology ledger — the 3-replica role fleet flags.
+    uses, tokens, topo = estimate(fns["test_roles_marks_scheduler_driving"])
+    assert uses and tokens == 0 and topo == 3
 
 
 def test_fault_injection_tests_carry_slow_marker():

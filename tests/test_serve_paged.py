@@ -467,3 +467,72 @@ def test_release_returns_pool_byte_whole_reservations_included():
         [Request(id=9, prompt=prompts[0], max_new_tokens=2)]
     )
     assert done[9].status == "ok"
+
+
+def test_handoff_reservation_accounting_byte_whole():
+    """ISSUE 15 satellite: PagePool reservation accounting across a
+    prefill->decode hand-off. In one global tick the SOURCE releases
+    everything (mapped page refs AND its unconsumed admission
+    reservation — ``preempt`` goes through ``release_slot``) while the
+    DESTINATION re-reserves the request's remaining worst case; and an
+    ABORTED mid-transfer request (preempted, never adopted) leaves both
+    pools byte-whole through the hardened ``release()`` sweep — the
+    PR 13 pin extended across two engines."""
+    cfg = ServeConfig(spec=TINY_SPEC, slots=2, capacity=32, page_size=8,
+                      num_pages=8)
+    src_eng, dst_eng = InferenceEngine(cfg), InferenceEngine(cfg)
+    src, dst = Scheduler(src_eng), Scheduler(dst_eng)
+    prompt = np.arange(1, 7, dtype=np.int32)
+    req = Request(id=0, prompt=prompt, max_new_tokens=10)
+    need = src_eng.pages_needed(6 + 10)
+    src.begin()
+    dst.begin()
+    src.submit(req)
+    src.tick()  # admit + prefill + first token: active, pages held
+    held = int(src_eng.table_len[0])
+    assert held >= 1
+    # Mid-flight the source holds mapped pages plus the rest of its
+    # admission promise.
+    assert src_eng.pages.free == src_eng.num_pages - held
+    assert src_eng.pages.reserved == need - held
+
+    pre = src.preempt(0)
+    # Source side released in full: refs AND reservations, same tick.
+    assert src_eng.pages.free == src_eng.num_pages
+    assert src_eng.pages.reserved == 0
+    assert int(src_eng.reserved_for[0]) == 0
+
+    slot = dst.adopt(pre)
+    # Destination re-reserved the worst case and mapped the moved
+    # pages out of that promise.
+    assert int(dst_eng.table_len[slot]) == held
+    assert dst_eng.pages.reserved == need - held
+    assert dst_eng.pages.free == dst_eng.num_pages - held
+    done_d = None
+    while not dst.idle:
+        dst.tick()
+    done_d, _ = dst.collect()
+    assert done_d[0].status == "ok" and len(done_d[0].tokens) == 10
+    src.release()
+    dst.release()
+    for eng in (src_eng, dst_eng):
+        assert eng.pages.free == eng.num_pages
+        assert eng.pages.reserved == 0
+
+    # Aborted mid-transfer: preempt again on a fresh run, then DROP the
+    # preempted state instead of adopting — release() returns both
+    # pools byte-whole (the dumped pages were host copies; nothing on
+    # device is pinned by them).
+    src.begin()
+    dst.begin()
+    src.submit(req)
+    src.tick()
+    pre = src.preempt(0)
+    assert pre.pos.shape[0] >= 1  # the dump really carried pages
+    src.release()
+    dst.release()
+    for eng in (src_eng, dst_eng):
+        assert eng.pages.free == eng.num_pages
+        assert eng.pages.reserved == 0
+        assert (eng.table_len == 0).all()
+        assert (eng.reserved_for == 0).all()
