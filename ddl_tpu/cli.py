@@ -44,6 +44,13 @@ VARIANTS = (
     # continuous batching (ddl_tpu.serve) — loads params-only from any
     # trained topology's checkpoint.
     "serve",
+    # The digital twin (ISSUE 18): replay a named scenario from
+    # ddl_tpu.serve.scenarios on the cost-model engine (serve.sim) —
+    # the REAL router/scheduler/controller control plane over engines
+    # that charge fitted virtual time instead of computing. Tick-for-
+    # tick decision parity with the real fleet; million-request scale
+    # on a laptop CPU.
+    "sim",
 )
 
 
@@ -493,6 +500,33 @@ def build_parser() -> argparse.ArgumentParser:
                          "to priority — how far below --shed-threshold "
                          "the class starts shedding at the router). "
                          "Unnamed classes get defaults")
+    sm = p.add_argument_group(
+        "sim options",
+        "the 'sim' variant replays a named scenario "
+        "(ddl_tpu.serve.scenarios) on the cost-model digital twin "
+        "(ddl_tpu.serve.sim): the real router/scheduler/controller "
+        "drive engines that charge fitted per-phase virtual time "
+        "instead of computing — tick-for-tick decision parity with the "
+        "real fleet at million-request scale; --replicas, --autoscale/"
+        "--max-replicas, --json, --metrics-out and --trace-dir apply "
+        "as on serve (topology/traffic shape flags come from the "
+        "scenario, not the serve flags)",
+    )
+    sm.add_argument("--scenario", default=None, metavar="NAME[:K=V,..]",
+                    help="scenario to replay (serve.scenarios.SCENARIOS: "
+                         "bulk_burst, replica_crash, diurnal, crash_storm, "
+                         "role_mix, longtail_prefix), with optional "
+                         "comma-joined overrides horizon=, max_requests=, "
+                         "rate_scale=, seed= (traffic scale — rejected on "
+                         "pinned-request scenarios) and replicas= "
+                         "(topology scale)")
+    sm.add_argument("--fit", default=None, metavar="METRICS_JSONL",
+                    help="fit the twin's per-phase costs from a MEASURED "
+                         "run's --metrics-out file "
+                         "(obs.goodput.phase_cost_fit: time_in_seconds"
+                         "{phase=} over the phase's work units); default: "
+                         "the documented CPU-calibrated CostModel "
+                         "defaults")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-process JAX world before training "
                         "(jax.distributed over DCN — the mpiexec-MPMD "
@@ -729,6 +763,16 @@ _SERVE_ONLY_DESTS = (
     "replicas", "traffic", "slo", "slo_rules", "autoscale", "max_replicas",
     "roles", "speculate",
 )
+_SIM_ONLY_DESTS = ("scenario", "fit")
+# Serve flags whose job the SCENARIO definition does on the sim variant
+# (topology, traffic shape, per-request policy): changed-from-default
+# values reject loudly instead of silently losing to the scenario.
+# --replicas / --autoscale / --max-replicas stay live — they are the
+# twin's scale and policy-sweep knobs.
+_SIM_REJECT_DESTS = tuple(
+    d for d in _SERVE_ONLY_DESTS
+    if d not in ("replicas", "autoscale", "max_replicas")
+)
 
 
 def _build_obs(args, *, config=None, mesh=None, make_tracer=True):
@@ -914,7 +958,8 @@ def _run_lm(args) -> int:
     procedural copy task (platform/multihost setup already done by
     ``main``). Reuses the shared flags; MNIST-only and serve-only flags
     fail loudly (see ``_reject_foreign_flags``)."""
-    _reject_foreign_flags(args, "lm", _MNIST_ONLY_DESTS + _SERVE_ONLY_DESTS)
+    _reject_foreign_flags(args, "lm", _MNIST_ONLY_DESTS + _SERVE_ONLY_DESTS
+                           + _SIM_ONLY_DESTS)
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
     from .data.lm import synthesize_copy
@@ -1328,13 +1373,159 @@ def _run_serve_router(args, cfg) -> int:
     return 0
 
 
+def _run_sim(args) -> int:
+    """The ``sim`` variant (ISSUE 18): replay a named scenario on the
+    cost-model digital twin — the REAL router/scheduler/controller
+    control plane over ``serve.sim.CostModelEngine`` replicas that
+    charge fitted per-phase virtual time instead of computing. Every
+    routing/admission/scale/crash decision is tick-identical to the
+    real fleet (tests/test_twin.py pins it); tokens are hashes and the
+    clock is virtual, which is what buys million-request scale on CPU."""
+    _reject_foreign_flags(args, "sim", _MNIST_ONLY_DESTS
+                          + _TRAIN_ONLY_DESTS + _SIM_REJECT_DESTS)
+    if args.scenario is None:
+        raise SystemExit(
+            "sim requires --scenario NAME[:key=value,...] (choices: "
+            "bulk_burst, replica_crash, diurnal, crash_storm, role_mix, "
+            "longtail_prefix)"
+        )
+    from .models.transformer import LMSpec
+    from .obs.goodput import fleet_summary, phase_cost_fit
+    from .serve.router import Router
+    from .serve.scenarios import parse_scenario
+    from .serve.sim import CostModel, sim_engine_factory
+
+    try:
+        scn, over = parse_scenario(args.scenario)
+    except ValueError as e:
+        raise SystemExit(f"--scenario: {e}")
+    spec = LMSpec(vocab=args.vocab, d_model=args.d_model,
+                  num_heads=args.heads, num_layers=args.layers,
+                  d_ff=args.d_ff)
+    cost = CostModel()
+    if args.fit is not None:
+        try:
+            cost = CostModel.from_phase_fit(phase_cost_fit(args.fit))
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--fit: {e}")
+    replicas = over.pop("replicas", None)
+    if args.replicas is not None:
+        replicas = args.replicas
+    acfg = None
+    if args.autoscale is not None:
+        from .serve.controller import parse_autoscale_spec
+
+        try:
+            acfg = parse_autoscale_spec(
+                args.autoscale, max_replicas=args.max_replicas,
+                replicas=replicas if replicas is not None
+                else scn.replicas,
+            )
+        except ValueError as e:
+            raise SystemExit(f"--autoscale: {e}")
+    elif args.max_replicas is not None:
+        raise SystemExit(
+            "--max-replicas requires --autoscale (it caps the fleet "
+            "the controller may grow; pass --autoscale '' for defaults)"
+        )
+    try:
+        traffic = scn.build_traffic(args.vocab, **over)
+        rcfg = scn.router_config(
+            spec, replicas=replicas,
+            engine_factory=sim_engine_factory(cost),
+        )
+        controller = scn.make_controller(autoscale=acfg,
+                                         replicas=replicas)
+    except ValueError as e:
+        raise SystemExit(f"sim config error: {e}")
+    registry, writer, _ = _build_obs(args, config=rcfg.serve,
+                                     make_tracer=False)
+    tracer = None
+    if args.trace_dir:
+        from .obs.trace import Tracer, host_trace_file
+
+        # keep=True: the per-class SLO derivation reads the records
+        # back — a twin trace renders through the SAME obs.analyze
+        # incident table as a real fleet's.
+        tracer = Tracer(host_trace_file(args.trace_dir), keep=True)
+    monitor = None
+    if scn.slo_rule_classes:
+        if registry is None:
+            from .obs import MetricRegistry
+
+            registry = MetricRegistry()
+        from .obs.slo import SloMonitor
+
+        monitor = SloMonitor(scn.slo_rules(), registry, tracer=tracer)
+    exporter = None
+    try:
+        try:
+            router = Router(rcfg, registry=registry, tracer=tracer,
+                            slo_monitor=monitor, controller=controller)
+        except ValueError as e:
+            raise SystemExit(f"sim config error: {e}")
+        exporter = _start_exporter(args, registry)
+        # No warmup: the twin compiles nothing — that is the point.
+        done, rstats = router.run(traffic)
+    finally:
+        if exporter is not None:
+            exporter.close()
+        if tracer is not None:
+            tracer.close()
+        if writer is not None:
+            writer.close()
+    from .serve.engine_iface import engine_kind
+
+    vt = {"prefill": 0.0, "decode": 0.0, "handoff": 0.0, "total": 0.0}
+    for eng in router.engines:
+        if eng is not None and engine_kind(eng) == "sim":
+            for k, v in eng.virtual_time().items():
+                vt[k] += v
+    summary = rstats.summary()
+    print(f"sim: scenario {scn.name} | {rcfg.replicas} replicas "
+          f"(cost-model twin) | {len(traffic)} requests")
+    for name, row in summary["per_class"].items():
+        print(f"class {name}: {row['requests']} requests -> "
+              f"ok {row['ok']} shed {row['shed']} deadline "
+              f"{row['deadline_exceeded']}")
+    print(f"router: placements {summary['per_replica_requests']} | "
+          f"router sheds {rstats.router_sheds} | prefix hit rate "
+          f"{rstats.prefix_hit_rate:.0%}")
+    if rstats.fleet is not None:
+        fl = rstats.fleet
+        print(f"fleet: max {fl['max_replicas']} | scale out "
+              f"{fl['scale_outs']} in {fl['scale_ins']} (drains "
+              f"{fl['drains']}) | preemptions {fl['preemptions']} | "
+              f"crashes {fl['crashes']} (requeues {fl['requeues']})")
+    print(f"virtual time: prefill {vt['prefill']:.3f}s decode "
+          f"{vt['decode']:.3f}s handoff {vt['handoff']:.3f}s | total "
+          f"{vt['total']:.3f}s")
+    if args.json:
+        cls_of = {m.id: m.traffic_class for m in traffic}
+        print(json.dumps({
+            "variant": "sim",
+            "scenario": args.scenario,
+            "engine_kind": "sim",
+            "replicas": rcfg.replicas,
+            "cost_model": dataclasses.asdict(cost),
+            "router": summary,
+            "virtual_time": vt,
+            "slo_rules": _slo_report(monitor),
+            "fleet_digest": (fleet_summary(registry)
+                             if registry is not None else None),
+            "per_class": _class_tallies(done, cls_of),
+        }))
+    return 0
+
+
 def _run_serve(args) -> int:
     """The ``serve`` variant: continuous-batching KV-cache decode over a
     deterministic seeded prompt set (platform setup already done by
     ``main``). MNIST-only and training-only flags fail loudly (see
     ``_reject_foreign_flags``)."""
     _reject_foreign_flags(args, "serve",
-                          _MNIST_ONLY_DESTS + _TRAIN_ONLY_DESTS)
+                          _MNIST_ONLY_DESTS + _TRAIN_ONLY_DESTS
+                          + _SIM_ONLY_DESTS)
     if args.multihost:
         raise SystemExit(
             "serve is single-controller (one process drives the tp mesh); "
@@ -1705,13 +1896,16 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(f"[ddl_tpu] multihost: process {jax.process_index()}/"
               f"{jax.process_count()}, {len(jax.devices())} global devices")
+    if args.variant == "sim":
+        return _run_sim(args)
     if args.variant == "serve":
         return _run_serve(args)
     if args.variant == "lm":
         return _run_lm(args)
     # MNIST variants get the same loud-fail hygiene for the serve-only
     # flags (a typo'd `sync --slots 8` must not silently train).
-    _reject_foreign_flags(args, args.variant, _SERVE_ONLY_DESTS)
+    _reject_foreign_flags(args, args.variant,
+                          _SERVE_ONLY_DESTS + _SIM_ONLY_DESTS)
     from .data import load_mnist
 
     dataset = load_mnist(

@@ -283,6 +283,133 @@ def fleet_summary(registry) -> dict:
             out[key] = int(sum(
                 c.value(**ls) for ls in c.label_sets()
             ))
+    g = registry.get("fleet_engine_sim")
+    if g is not None and g.kind == "gauge":
+        # Twin transparency (ISSUE 18): the router stamps this gauge at
+        # construction, so /healthz and every fleet digest says whether
+        # the numbers came from real engines or the cost-model twin — a
+        # sim run can never masquerade as measured.
+        v = g.value()
+        if v is not None:
+            out["engine_kind"] = "sim" if v else "real"
+    return out
+
+
+# Per-phase cost fitting (ISSUE 18): phase name -> (fitted key, the
+# denominator metric that normalizes it, that metric's kind). The
+# denominators are the exact unit each cost-model charge uses:
+# prefill charges per PROMPT TOKEN, decode per BATCHED STEP (one
+# histogram sample per decode call), hand-off per MOVED PAGE.
+_PHASE_FIT = {
+    "prefill": ("prefill_s_per_token", "serve_prefill_tokens_total",
+                "counter"),
+    "decode": ("decode_s_per_tick", "serve_decode_step_seconds",
+               "histogram"),
+    "handoff": ("handoff_s_per_page", "handoff_pages_total", "counter"),
+}
+
+
+def _last_snapshot(path) -> list[dict]:
+    import json
+
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("record") == "snapshot":
+                last = rec
+    if last is None:
+        raise ValueError(
+            f"{path}: no snapshot records — not a MetricsWriter JSONL "
+            "(or the run never flushed one)"
+        )
+    return last["metrics"]
+
+
+def phase_cost_fit(source, *, phases=("prefill", "decode")) -> dict:
+    """Fit per-phase virtual-time costs from a MEASURED run — the
+    digital twin's cost table (``serve.sim.CostModel.from_phase_fit``),
+    normalized per unit of work:
+
+    - ``prefill_s_per_token`` = ``time_in_seconds{phase=prefill}`` /
+      ``serve_prefill_tokens_total``
+    - ``decode_s_per_tick``   = ``time_in_seconds{phase=decode}`` /
+      ``serve_decode_step_seconds`` sample count (batched steps)
+    - ``handoff_s_per_page``  = ``time_in_seconds{phase=handoff}`` /
+      ``handoff_pages_total``
+
+    ``source`` is a live :class:`~ddl_tpu.obs.registry.MetricRegistry`
+    (a replica registry — that is where the serve-side attribution
+    lands) or a path to a ``MetricsWriter`` JSONL (the LAST snapshot
+    wins — costs are cumulative ratios). Any requested phase whose
+    numerator or denominator is missing/zero is a LOUD error naming the
+    phase and the absent metric — a fit from a run that never decoded
+    must fail, not silently return a zero cost. Fit ``handoff`` only
+    from disaggregated runs (default phases omit it)."""
+    bad = [p for p in phases if p not in _PHASE_FIT]
+    if bad:
+        raise ValueError(
+            f"unknown fit phase(s) {', '.join(map(repr, bad))} "
+            f"(fittable: {', '.join(_PHASE_FIT)})"
+        )
+    if hasattr(source, "get") and not isinstance(source, (str, bytes)) \
+            and not hasattr(source, "__fspath__"):
+        def num_of(phase):
+            g = source.get("time_in_seconds")
+            if g is None or g.kind != "gauge":
+                return None
+            return g.value(phase=phase)
+
+        def den_of(name, kind):
+            m = source.get(name)
+            if m is None or m.kind != kind:
+                return None
+            if kind == "histogram":
+                return sum(m.count(**ls) for ls in m.label_sets())
+            return sum(m.value(**ls) for ls in m.label_sets())
+    else:
+        metrics = _last_snapshot(source)
+
+        def num_of(phase):
+            for e in metrics:
+                if e["name"] == "time_in_seconds" \
+                        and e.get("labels", {}).get("phase") == phase:
+                    return e.get("value")
+            return None
+
+        def den_of(name, kind):
+            got = [e for e in metrics
+                   if e["name"] == name and e.get("kind") == kind]
+            if not got:
+                return None
+            key = "count" if kind == "histogram" else "value"
+            return sum(e.get(key, 0) for e in got)
+
+    out: dict = {}
+    problems = []
+    for phase in phases:
+        key, den_name, den_kind = _PHASE_FIT[phase]
+        num = num_of(phase)
+        den = den_of(den_name, den_kind)
+        if num is None or num <= 0:
+            problems.append(
+                f"{phase} (time_in_seconds{{phase={phase}}} absent or 0 "
+                "— the run never attributed that phase)"
+            )
+        elif not den:
+            problems.append(
+                f"{phase} ({den_name} absent or 0 — no work units to "
+                "normalize by)"
+            )
+        else:
+            out[key] = float(num) / float(den)
+    if problems:
+        raise ValueError(
+            "phase_cost_fit: cannot fit " + "; ".join(problems)
+        )
     return out
 
 
@@ -291,6 +418,7 @@ __all__ = [
     "attribute_train_span",
     "fleet_summary",
     "goodput_summary",
+    "phase_cost_fit",
     "TRAIN_PHASES",
     "SERVE_PHASES",
     "GOODPUT_PHASES",

@@ -218,6 +218,67 @@ class FaultInjector:
         return None
 
 
+class FaultStorm:
+    """Sequenced ``replica_crash`` delivery — the multi-fault injector
+    behind the ``crash_storm`` scenario (``serve.scenarios``). Exposes
+    the exact surface the fleet controller consumes from
+    :class:`FaultInjector` (``crashes_replica`` / ``rearm`` /
+    ``crash_pending`` / ``spec``), firing each spec once in ``step``
+    order, at most one per tick — two crashes due the same tick deliver
+    on consecutive ticks, deterministically. ``spec`` reads as the
+    first unfired spec so the controller's "never fired" run-end error
+    names the crash that was actually missed."""
+
+    def __init__(self, specs):
+        specs = tuple(specs)
+        if not specs:
+            raise ValueError("FaultStorm needs at least one FaultSpec")
+        bad = sorted({s.kind for s in specs if s.kind != "replica_crash"})
+        if bad:
+            raise ValueError(
+                f"FaultStorm sequences replica_crash faults only, got "
+                f"{', '.join(bad)}"
+            )
+        self.specs = tuple(sorted(specs, key=lambda s: (s.step, s.replica)))
+        self._fired = [False] * len(self.specs)
+
+    @property
+    def spec(self) -> FaultSpec:
+        for spec, fired in zip(self.specs, self._fired):
+            if not fired:
+                return spec
+        return self.specs[-1]
+
+    @property
+    def crash_pending(self) -> bool:
+        return not all(self._fired)
+
+    def rearm(self) -> None:
+        self._fired = [False] * len(self.specs)
+
+    def stalls(self, request_id: int) -> bool:
+        return False
+
+    def crashes_replica(self, tick: int) -> int | None:
+        for i, spec in enumerate(self.specs):
+            if not self._fired[i] and tick >= spec.step:
+                self._fired[i] = True
+                return spec.replica
+        return None
+
+
+def parse_fault_storm(text: str):
+    """``;``-separated :func:`parse_fault` specs — one spec builds a
+    plain :class:`FaultInjector`, several build a :class:`FaultStorm`:
+    ``replica_crash@3:1;replica_crash@9:2``."""
+    specs = [parse_fault(part) for part in text.split(";") if part]
+    if not specs:
+        raise ValueError(f"empty fault spec {text!r}")
+    if len(specs) == 1:
+        return FaultInjector(specs[0])
+    return FaultStorm(specs)
+
+
 # -- checkpoint chaos ---------------------------------------------------------
 
 

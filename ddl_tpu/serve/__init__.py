@@ -20,6 +20,14 @@ batching — the inference half of the sharded-mesh story.
   specialized replicas with the first-token page hand-off coordinator
 - ``serve.speculate`` — speculative decoding drafts (n-gram / prompt
   lookup) verified bit-identically through free decode-batch lanes
+- ``serve.engine_iface`` — the ServeEngine protocol: the narrow engine
+  surface the control plane actually calls (ISSUE 18)
+- ``serve.sim``       — the cost-model engine: no arrays, per-phase
+  virtual time, identical host bookkeeping — the million-request
+  digital twin's engine
+- ``serve.scenarios`` — the named scenario library (seeded burst,
+  diurnal, crash-storm, role-mix, longtail-prefix) shared by the
+  pinned tests, the ``ddl_tpu sim`` CLI and the twin bench
 
 Quickstart (also ``python -m ddl_tpu serve --help``)::
 
@@ -45,7 +53,16 @@ from .disagg import (  # noqa: F401
     validate_roles,
 )
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
+from .engine_iface import ServeEngine, engine_kind  # noqa: F401
 from .prefix import PrefixIndex  # noqa: F401
+from .scenarios import (  # noqa: F401
+    SCENARIOS,
+    Scenario,
+    SeededRequest,
+    get_scenario,
+    parse_scenario,
+)
+from .sim import CostModel, CostModelEngine, sim_engine_factory  # noqa: F401
 from .speculate import greedy_accept, propose_draft  # noqa: F401
 from .router import (  # noqa: F401
     ClassSpec,
@@ -70,6 +87,8 @@ __all__ = [
     "AutoscaleConfig",
     "ClassSpec",
     "Completion",
+    "CostModel",
+    "CostModelEngine",
     "DisaggCoordinator",
     "FleetController",
     "InferenceEngine",
@@ -82,16 +101,24 @@ __all__ = [
     "Router",
     "RouterConfig",
     "RouterStats",
+    "SCENARIOS",
+    "Scenario",
     "Scheduler",
+    "SeededRequest",
     "ServeConfig",
+    "ServeEngine",
     "ServeStats",
     "derive_request_slo",
+    "engine_kind",
+    "get_scenario",
     "greedy_accept",
     "parse_autoscale_spec",
     "parse_roles_spec",
+    "parse_scenario",
     "parse_slo_spec",
     "parse_traffic_spec",
     "propose_draft",
     "request_slo_samples",
+    "sim_engine_factory",
     "validate_roles",
 ]

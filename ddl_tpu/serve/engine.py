@@ -188,7 +188,14 @@ class InferenceEngine:
     already-placed device tree from another engine on an identical
     mesh (the multi-replica router's one-checkpoint contract,
     ISSUE 8) — no re-placement, no transient duplicate copy; safe
-    because no compiled program donates the params argument."""
+    because no compiled program donates the params argument.
+
+    This class is one implementation of the control-plane engine
+    contract (:class:`~ddl_tpu.serve.engine_iface.ServeEngine`); the
+    device-free twin (:class:`~ddl_tpu.serve.sim.CostModelEngine`,
+    ``kind == "sim"``) is the other."""
+
+    kind = "real"
 
     def __init__(self, config: ServeConfig, params=None, *,
                  placed_params=None):

@@ -1,0 +1,105 @@
+"""The serve engine interface — the narrow surface the control plane
+actually calls (ISSUE 18).
+
+Every control decision in the serve stack — router placement, door
+shedding, autoscale/drain/crash-heal, preemption, disagg hand-off, SLO
+burn, anomaly edges — is deterministic host logic on a tick clock; only
+the engine underneath touches a device.  :class:`ServeEngine` is the
+written-down contract of that boundary: the attributes and methods
+``Scheduler`` / ``Router`` / ``FleetController`` / ``DisaggCoordinator``
+read, and nothing else.  Two implementations exist:
+
+* :class:`~ddl_tpu.serve.engine.InferenceEngine` (``kind == "real"``)
+  — placed params, compiled programs, device arrays.
+* :class:`~ddl_tpu.serve.sim.CostModelEngine` (``kind == "sim"``) — no
+  arrays; advances the same host bookkeeping (page pool, block tables,
+  prefix index) and charges per-phase *virtual* time fitted from the
+  goodput plane's measured ``time_in_seconds{phase=}``.
+
+The contract is structural (``typing.Protocol``): the control plane
+stays duck-typed and the real engine needs no inheritance edge — the
+protocol is the *specification*, checked by tests, not a base class.
+Because every control decision reads only this surface, any engine
+satisfying it replays the identical controller event timeline — the
+tick-for-tick parity pin in tests/test_twin.py.
+"""
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+__all__ = ["ServeEngine", "engine_kind"]
+
+
+@runtime_checkable
+class ServeEngine(Protocol):
+    """What the control plane may touch on an engine.
+
+    Attributes (host state the scheduler/controller read directly):
+
+    * ``kind`` — ``"real"`` or ``"sim"``; surfaced in ``fleet_summary``
+      and ``/healthz`` so a twin run can never masquerade as measured.
+    * ``config`` — the :class:`~ddl_tpu.serve.engine.ServeConfig`.
+    * ``paged`` / ``page_size`` / ``max_pages`` / ``num_pages`` — KV
+      layout geometry (all zero when contiguous).
+    * ``pages`` — the :class:`~ddl_tpu.serve.cache.PagePool` (paged).
+    * ``tables`` / ``table_len`` / ``reserved_for`` — block tables.
+    * ``prefix`` — the :class:`~ddl_tpu.serve.prefix.PrefixIndex` or
+      ``None``; ``page_copies`` — CoW tail-copy counter.
+    * ``mesh`` — exposes ``.devices.flat`` (memory sampler, peak-FLOPs
+      lookup) and ``.devices.size`` (MFU denominator).
+    * ``params`` — opaque; replicas share one tree via
+      ``placed_params`` (may be ``None`` for a cost-model engine).
+    * ``compile_hook`` — set by the scheduler; the engine calls
+      ``hook(kind, key)`` once per distinct program build.
+    * ``last_attend_width`` — rows the last decode attended (the
+      paged-aware ``serve_flops_per_token`` denominator).
+    """
+
+    kind: str
+
+    # -- compute ticks ------------------------------------------------------
+    def prefill(self, prompt, *, slot: int, request_id: int, base: int = 0,
+                _bucket: int | None = None): ...
+
+    def decode(self, last_tokens, lengths, request_ids, active, *,
+               _pages: int | None = None): ...
+
+    # -- shape/bucket ladders ----------------------------------------------
+    def prefill_bucket(self, prompt_len: int) -> int: ...
+
+    def decode_page_bucket(self, pages: int) -> int: ...
+
+    # -- paged page management ---------------------------------------------
+    def pages_needed(self, rows: int) -> int: ...
+
+    def reserve_pages(self, slot: int, n: int) -> None: ...
+
+    def reclaim_pages(self, need: int) -> bool: ...
+
+    def release_slot(self, slot: int) -> None: ...
+
+    # -- cross-replica hand-off (preempt / crash requeue / disagg) ----------
+    def dump_slot_pages(self, slot: int): ...
+
+    def load_slot_pages(self, slot: int, k, v, pos) -> list[int]: ...
+
+    def alias_slot_pages(self, dst_slot: int, src_slot: int,
+                         rows: int) -> int: ...
+
+    # -- prefix cache -------------------------------------------------------
+    def prefix_fetch(self, entry_id: int, n: int, slot: int) -> int: ...
+
+    def prefix_release(self, entry_id: int) -> None: ...
+
+    def prefix_store(self, prompt, slot: int) -> bool: ...
+
+    # -- lifecycle ----------------------------------------------------------
+    def reset(self) -> None: ...
+
+
+def engine_kind(engine) -> str:
+    """``"real"`` or ``"sim"`` for any engine object.  Pre-interface
+    engines (no ``kind`` attribute) are real by construction — the
+    cost-model engine is the only one that ever says otherwise, so a
+    missing attribute defaults loud-side-safe to ``"real"``."""
+    return str(getattr(engine, "kind", "real"))

@@ -69,6 +69,7 @@ from ..models import transformer
 from ..obs.trace import Tracer
 from .disagg import DisaggCoordinator, validate_roles
 from .engine import InferenceEngine, ServeConfig
+from .engine_iface import engine_kind
 from .scheduler import (
     MIN_PREFIX_HIT,
     Completion,
@@ -141,6 +142,15 @@ class RouterConfig:
     # needs the paged layout (the hand-off moves KV pages) and both
     # sides present (serve.disagg.validate_roles).
     roles: tuple[str, ...] | None = None
+    # Engine construction override (ISSUE 18, serve.engine_iface): a
+    # callable ``factory(serve_config, params=None, *, placed_params=...)``
+    # returning a ServeEngine — the digital twin passes
+    # ``serve.sim.sim_engine_factory()`` here to run the IDENTICAL
+    # control plane over cost-model engines. None builds the real
+    # InferenceEngine, byte-identical to the pre-interface router (no
+    # params are initialized or placed when a factory is supplied —
+    # the factory owns that decision).
+    engine_factory: object | None = None
 
 
 @dataclasses.dataclass
@@ -324,12 +334,16 @@ class Router:
         self.classes = {c.name: c for c in config.classes}
         self.tracer = tracer if tracer is not None else Tracer()
         self.registry = registry
-        if params is None:
-            import jax
+        factory = config.engine_factory
+        if factory is None:
+            factory = InferenceEngine
+            if params is None:
+                import jax
 
-            params = transformer.init_lm_params(
-                jax.random.PRNGKey(config.serve.seed), config.serve.spec
-            )
+                params = transformer.init_lm_params(
+                    jax.random.PRNGKey(config.serve.seed), config.serve.spec
+                )
+        self._engine_factory = factory
         self._injector = injector
         self._peak_flops = peak_flops
         self.engines: list[InferenceEngine | None] = []
@@ -338,12 +352,21 @@ class Router:
             # host tree; every other replica SHARES its device arrays
             # (prefill/decode donate only the cache argument, never
             # params, so sharing is safe — and no replica ever pays a
-            # transient duplicate placement).
-            eng = (InferenceEngine(config.serve, params=params) if k == 0
-                   else InferenceEngine(
+            # transient duplicate placement). A custom engine_factory
+            # receives the identical wiring (a cost-model engine simply
+            # ignores the shared tree).
+            eng = (factory(config.serve, params=params) if k == 0
+                   else factory(
                        config.serve,
                        placed_params=self.engines[0].params))
             self.engines.append(eng)
+        if registry is not None:
+            # Twin-transparency marker (ISSUE 18): /healthz and
+            # fleet_summary read this non-creating — a sim run can
+            # never masquerade as a measured one.
+            registry.gauge("fleet_engine_sim").set(
+                1.0 if engine_kind(self.engines[0]) == "sim" else 0.0
+            )
         # The fleet's ONE placed param tree, held by the driver itself:
         # scale-out and crash healing build replacement replicas from
         # it even after replica 0 is gone (ISSUE 13).
@@ -400,6 +423,11 @@ class Router:
         # decision re-runs on every pass unless the request is
         # shed_exempt (already admitted before a crash).
         self._door: list[tuple[Request, bool]] = []
+        # Per-routing-pass Pressure cache (ISSUE 18): run() arms it for
+        # the door+arrival pass of each tick; None means _route probes
+        # fresh (the direct-call path). Decision-identical to fresh
+        # probes — see _route.
+        self._pressure_cache: dict | None = None
         self._warm_items = None
         self._armed = False
         self._run_counters: dict | None = None
@@ -445,8 +473,8 @@ class Router:
         controller scales each phase off its own pressure). Returns
         the replica id."""
         k = len(self.engines)
-        eng = InferenceEngine(self.config.serve,
-                              placed_params=self._placed_params)
+        eng = self._engine_factory(self.config.serve,
+                                   placed_params=self._placed_params)
         self.engines.append(eng)
         self.roles.append(role)
         reg = None
@@ -690,7 +718,21 @@ class Router:
             # arrival above (first=False on the retry).
             self._door.append((req, False))
             return
-        pressures = {k: self.scheds[k].pressure() for k in cand}
+        # One Pressure probe per candidate per ROUTING PASS, not per
+        # request: during a pass only submit() mutates scheduler state,
+        # and submit changes exactly pending_total (+1 on the chosen
+        # replica — applied to the cache below), so the cached probe is
+        # decision-identical to a fresh one while routing a
+        # million-request trace stops being O(replicas · pending) per
+        # arrival. Outside run() (cache unarmed) probes stay fresh.
+        cache = self._pressure_cache
+        if cache is None:
+            pressures = {k: self.scheds[k].pressure() for k in cand}
+        else:
+            for k in cand:
+                if k not in cache:
+                    cache[k] = self.scheds[k].pressure()
+            pressures = cache
         # While the fleet can still scale out, the door shed DEFERS —
         # capacity is coming, and acting on load beats shedding it
         # (ISSUE 13: the bulk-burst that fires bulk_shed on a static
@@ -746,6 +788,19 @@ class Router:
                 else "router_load_placements_total"
             ).inc()
         self.scheds[replica].submit(req)
+        if self._pressure_cache is not None \
+                and replica in self._pressure_cache:
+            # Keep the cached probe exact: submit() queued one more
+            # pending request on this replica and changed nothing else
+            # the placement/shed reads (occupied slots, pages and
+            # prefix state move only in tick()/preempt/adopt — never
+            # mid-pass).
+            p = self._pressure_cache[replica]
+            self._pressure_cache[replica] = dataclasses.replace(
+                p, pending_total=p.pending_total + 1,
+                waiting_eligible=p.waiting_eligible
+                + (1 if req.arrival <= t else 0),
+            )
 
     # -- the replica-stepping loop -----------------------------------------
 
@@ -811,14 +866,22 @@ class Router:
             ):
                 if ctrl is not None:
                     ctrl.begin_tick(t, done)
-                if self._door:
-                    door, self._door = self._door, []
-                    for req, first in door:
-                        self._route(req, t, done, cls_of, counters,
-                                    first=first)
-                while i < len(reqs) and reqs[i].arrival <= t:
-                    self._route(reqs[i], t, done, cls_of, counters)
-                    i += 1
+                # One routing pass (door retries + due arrivals) shares
+                # one Pressure cache; the controller/tick phases below
+                # mutate scheduler state, so the cache dies with the
+                # pass.
+                self._pressure_cache = {}
+                try:
+                    if self._door:
+                        door, self._door = self._door, []
+                        for req, first in door:
+                            self._route(req, t, done, cls_of, counters,
+                                        first=first)
+                    while i < len(reqs) and reqs[i].arrival <= t:
+                        self._route(reqs[i], t, done, cls_of, counters)
+                        i += 1
+                finally:
+                    self._pressure_cache = None
                 if ctrl is not None:
                     ctrl.after_route(t)
                 if self.disagg is not None:

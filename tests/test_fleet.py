@@ -15,6 +15,12 @@ Budget discipline: the burst arms live in a helper (the test_slo
 pattern); the tier-1 tests stay within the tests/test_markers.py audit
 bounds — ``max_replicas=`` literals now count into the topology budget
 exactly like ``replicas=``.
+
+The seeded bulk-burst and replica-crash specs themselves now live in
+``ddl_tpu.serve.scenarios`` (ISSUE 18 dedupe): the pinned tests build
+their runs from the SAME named scenarios the ``ddl_tpu sim`` CLI and
+the twin bench replay, so the pins and the product scenario library
+cannot drift.
 """
 
 import json
@@ -23,12 +29,11 @@ import urllib.request
 import numpy as np
 import pytest
 
-from ddl_tpu.data.lm import synthesize_mixed_traffic
 from ddl_tpu.models.transformer import TINY_SPEC
 from ddl_tpu.obs import MetricRegistry, Tracer
 from ddl_tpu.obs.export import MetricsExporter
 from ddl_tpu.obs.goodput import fleet_summary
-from ddl_tpu.obs.slo import SloMonitor, SloRule
+from ddl_tpu.obs.slo import SloMonitor
 from ddl_tpu.resilience.faults import FaultInjector, FaultSpec, parse_fault
 from ddl_tpu.serve import (
     AutoscaleConfig,
@@ -42,6 +47,7 @@ from ddl_tpu.serve import (
     ServeConfig,
     parse_autoscale_spec,
 )
+from ddl_tpu.serve.scenarios import BULK_BURST, REPLICA_CRASH
 
 SPEC = TINY_SPEC
 
@@ -169,22 +175,17 @@ def test_replica_crash_heals_and_completes_exactly_once():
     request completes exactly once with status "ok" and tokens
     identical to a crash-free run — the "requeued" placeholder is
     overwritten exactly once, router_requests_total counts each arrival
-    once, and the crashed replica's stats slot reads None."""
-    cfg = ServeConfig(spec=SPEC, slots=1, capacity=32, page_size=8,
-                      num_pages=8)
-    classes = (ClassSpec("bulk", priority=1),)
-    reqs = [Request(id=i, prompt=_prompt(6, 10 + i), max_new_tokens=6,
-                    arrival=i // 2, traffic_class="bulk")
-            for i in range(4)]
-    router = Router(RouterConfig(serve=cfg, replicas=2, classes=classes))
+    once, and the crashed replica's stats slot reads None.
+
+    The whole run — seeded requests, topology, fault schedule,
+    autoscale policy — is built from the named REPLICA_CRASH scenario
+    (serve.scenarios), the same definition the sim CLI and twin bench
+    replay."""
+    reqs = REPLICA_CRASH.build_traffic(SPEC.vocab)
+    router = Router(REPLICA_CRASH.router_config(SPEC))
     done_o, stats_o = router.run(reqs)
 
-    inj = FaultInjector(FaultSpec(kind="replica_crash", step=2, replica=1))
-    ctrl = FleetController(
-        AutoscaleConfig(max_replicas=2, min_replicas=2, preempt=False,
-                        backlog_per_replica=10.0),
-        injector=inj,
-    )
+    ctrl = REPLICA_CRASH.make_controller()
     reg = MetricRegistry()
     router.registry = reg
     router.controller = ctrl
@@ -247,44 +248,16 @@ def test_replica_crash_heals_and_completes_exactly_once():
 
 def _burst_arm(autoscale: bool):
     """The ISSUE 10 seeded bulk-burst scenario (test_slo._burst_run's
-    traffic spec, verbatim) with the fleet controller as the only
-    delta: the static arm sheds and alerts; the autoscale arm scales
-    out instead. Returns (monitor, controller, router stats, done,
-    tracer)."""
-    traffic = synthesize_mixed_traffic(
-        classes={
-            "chat": dict(rate=0.3, prompt_min=4, prompt_max=8,
-                         max_new_tokens=2),
-            "bulk": dict(rate=0.4, prompt_min=4, prompt_max=8,
-                         max_new_tokens=2),
-        },
-        horizon=16, vocab=SPEC.vocab, seed=0,
-        burst=(4, 6, 6.0, "bulk"), max_requests=16,
-    )
-    rules = tuple(
-        SloRule(name=f"{c}_shed", metric="router_shed_total",
-                total_metric="router_requests_total",
-                labels={"class": c}, objective=0.5, fast_window=3,
-                slow_window=6)
-        for c in ("bulk", "chat")
-    )
+    traffic spec, verbatim — now the named BULK_BURST scenario in
+    serve.scenarios) with the fleet controller as the only delta: the
+    static arm sheds and alerts; the autoscale arm scales out instead.
+    Returns (monitor, controller, router stats, done, tracer)."""
+    traffic = BULK_BURST.build_traffic(SPEC.vocab)
     reg, tr = MetricRegistry(), Tracer()
-    mon = SloMonitor(rules, reg, tracer=tr)
-    cfg = RouterConfig(
-        serve=ServeConfig(spec=SPEC, slots=1, capacity=64),
-        replicas=1,
-        classes=(ClassSpec("chat", priority=0),
-                 ClassSpec("bulk", priority=1, shed_margin=1)),
-        shed_threshold=2,
-    )
-    ctrl = None
-    if autoscale:
-        ctrl = FleetController(AutoscaleConfig(
-            max_replicas=2, min_replicas=1, backlog_per_replica=2.0,
-            sustain_ticks=2, idle_ticks=4, preempt=False,
-        ))
-    router = Router(cfg, registry=reg, tracer=tr, slo_monitor=mon,
-                    controller=ctrl)
+    mon = SloMonitor(BULK_BURST.slo_rules(), reg, tracer=tr)
+    ctrl = BULK_BURST.make_controller() if autoscale else None
+    router = Router(BULK_BURST.router_config(SPEC), registry=reg,
+                    tracer=tr, slo_monitor=mon, controller=ctrl)
     done, rstats = router.run(traffic)
     return mon, ctrl, rstats, done, tr
 

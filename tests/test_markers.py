@@ -44,6 +44,15 @@ Bounds (per test function, per run):
   the three ledgers, not their sum). ``pytest.mark.parametrize`` cases
   are separate tier-1 tests and are deliberately NOT multiplied in.
 
+**Sim-only exemption (ISSUE 18)**: a test whose every engine is the
+cost-model twin — a ``CostModelEngine`` / ``sim_engine_factory`` name
+appears, no ``InferenceEngine`` appears, and every ``RouterConfig`` /
+``router_config`` site passes ``engine_factory=`` — compiles nothing
+and hashes its tokens on a virtual clock, so the per-token budgets
+above don't measure its cost; such tests are exempt even at
+million-request scale. One real engine anywhere (or one unfactored
+router site, which would build real engines) keeps the teeth.
+
 The estimate is a documented LOWER bound: unresolvable (non-literal)
 values contribute nothing, so the audit can miss creative obfuscation
 but can never false-positive on plain code. Pure AST — no jax import,
@@ -63,6 +72,7 @@ _PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts",
 _ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
 _ROUTER_CTORS = ("Router", "RouterConfig")
 _FLEET_CTORS = ("FleetController", "AutoscaleConfig")
+_SIM_NAMES = ("CostModelEngine", "sim_engine_factory")
 
 
 def _call_name(node: ast.Call) -> str | None:
@@ -112,6 +122,33 @@ def has_slow_marker(fn) -> bool:
         if isinstance(node, ast.Attribute) and node.attr == "slow":
             return True
     return False
+
+
+def sim_only(fn) -> bool:
+    """True when every engine this test can construct is the cost-model
+    twin (ISSUE 18): a sim name appears outside ``pytest.raises``, no
+    ``InferenceEngine`` does, and every ``RouterConfig`` /
+    ``router_config`` call site passes ``engine_factory=`` (a router
+    site without one builds real engines). Sound for plain code —
+    one real-engine path anywhere disqualifies."""
+    skip = _raises_nodes(fn)
+    saw_sim = False
+    for node in ast.walk(fn):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Name):
+            if node.id in _SIM_NAMES:
+                saw_sim = True
+            elif node.id == "InferenceEngine":
+                return False
+        elif isinstance(node, ast.Attribute) and node.attr in _SIM_NAMES:
+            saw_sim = True
+        elif isinstance(node, ast.Call):
+            if _call_name(node) in ("RouterConfig", "router_config") \
+                    and not any(kw.arg == "engine_factory"
+                                for kw in node.keywords):
+                return False
+    return saw_sim
 
 
 def estimate(fn) -> tuple[bool, int, int]:
@@ -211,7 +248,7 @@ def _audit(tree) -> list[tuple[str, int, int]]:
         if not fn.name.startswith("test"):
             continue
         uses, tokens, topo = estimate(fn)
-        if not uses or has_slow_marker(fn):
+        if not uses or has_slow_marker(fn) or sim_only(fn):
             continue
         if tokens > MAX_FAST_TOKENS or topo > MAX_FAST_TOPOLOGIES:
             out.append((fn.name, tokens, topo))
@@ -520,6 +557,50 @@ def test_speculate_roles_audit_estimator_extension():
     # into the topology ledger — the 3-replica role fleet flags.
     uses, tokens, topo = estimate(fns["test_roles_marks_scheduler_driving"])
     assert uses and tokens == 0 and topo == 3
+
+
+def test_twin_audit_estimator_extension():
+    """ISSUE 18 self-pin: a sim-only test — cost-model engines behind
+    every router site — is exempt from the scheduler budgets even at
+    MILLION-request scale (no compiles, hashed tokens, virtual clock),
+    while one real engine anywhere, or one router site without an
+    ``engine_factory=``, keeps the full teeth: the twin exemption can
+    never leak real-engine cost into tier-1."""
+    src = textwrap.dedent("""
+        def test_million_request_twin():
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=4)},
+                max_requests=1000000)
+            r = Router(RouterConfig(serve=ServeConfig(), replicas=128,
+                                    engine_factory=sim_engine_factory()))
+            r.run(t)
+
+        def test_real_engine_keeps_teeth():
+            CostModelEngine(ServeConfig())
+            eng = InferenceEngine(ServeConfig())
+            t = synthesize_mixed_traffic(
+                classes={"c": dict(rate=1.0, max_new_tokens=4)},
+                max_requests=100)
+            Scheduler(eng).run(t)
+
+        def test_unfactored_router_keeps_teeth():
+            sim = CostModelEngine(ServeConfig())
+            Router(RouterConfig(serve=ServeConfig(), replicas=3)).run(
+                synthesize_mixed_traffic(
+                    classes={"c": dict(rate=1.0, max_new_tokens=4)},
+                    max_requests=100))
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_real_engine_keeps_teeth",
+                     "test_unfactored_router_keeps_teeth"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    # The million-request twin test IS over every budget — and exempt.
+    uses, tokens, topo = estimate(fns["test_million_request_twin"])
+    assert uses and tokens == 4_000_000 and topo == 128
+    assert sim_only(fns["test_million_request_twin"])
+    assert not sim_only(fns["test_real_engine_keeps_teeth"])
+    assert not sim_only(fns["test_unfactored_router_keeps_teeth"])
 
 
 def test_fault_injection_tests_carry_slow_marker():
