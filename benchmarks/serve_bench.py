@@ -64,7 +64,18 @@ Plus head-to-head sections (ISSUE 4/7; skip with ``--skip-compare``):
   submit — serving them would need a worst-case capacity per slot that
   multiplies the budget. The paged arm (same rows as one shared pool)
   admits and completes everything, with hit-rate and pages-free rows
-  read from the registry.
+  read from the registry. The ISSUE 19 third arm serves the same mix
+  from an int8 pool (``kv_dtype="int8"``, per-head scales) sized to
+  the SAME BYTE envelope via ``serve.cache.kv_row_bytes`` — the
+  compression becomes extra pages, so the row to watch is
+  ``kv_pages_free`` (>= 1.8x the fp32 arm is the acceptance bar) with
+  ``tokens_identical`` vs the fp32 pool checked in situ.
+- **precision_memory** (ISSUE 19) — the train-policy A/B: one LM span
+  under ``precision="fp32"`` vs ``"bf16"`` with ``device_memory_*``
+  watermark gauges sampled around each (``obs.memory.MemorySampler``).
+  XLA:CPU reports no ``memory_stats()`` — the sampler self-latches off
+  and the section records the losses plus a TPU stub row for the next
+  hardware window.
 
 Every row is read from the ``ddl_tpu.obs`` MetricRegistry the
 scheduler publishes (counters + latency histograms observed from the
@@ -481,6 +492,71 @@ def main() -> None:
                       f"{longtail_compare['layout_paged']['completed_ok']}/"
                       f"{len(lt_requests)} ok under the same "
                       f"{budget_rows}-row budget", file=sys.stderr)
+                # -- ISSUE 19: the int8 arm under the SAME BYTE budget.
+                # The fp32 pool spends budget_rows * kv_row_bytes(fp32)
+                # bytes; the int8 pool's page count is whatever that
+                # byte envelope buys at the compressed row cost — the
+                # 4D/(D+4) compression becomes extra pages, and the
+                # acceptance bar is kv_pages_free >= 1.8x the fp32 arm
+                # with the fp32 pool's tokens reproduced (checked in
+                # situ; per-head absmax dequant is exact enough for
+                # greedy argmax at this spec — a mismatch is recorded,
+                # not hidden).
+                from ddl_tpu.serve.cache import kv_row_bytes
+
+                fp32_tokens = {i: done[i].tokens for i in done}
+                fp32_free = longtail_compare["layout_paged"][
+                    "kv_pages_free"]
+                budget_bytes = budget_rows * kv_row_bytes(spec, None)
+                pages8 = budget_bytes // (kv_row_bytes(spec, "int8") * ps)
+                done8, reg8 = _measure(
+                    ServeConfig(
+                        spec=spec, slots=4, capacity=args.capacity,
+                        temperature=args.temperature,
+                        compute_dtype=base_cfg["compute_dtype"],
+                        prefix_slots=4, page_size=ps,
+                        num_pages=int(pages8), kv_dtype="int8",
+                    ),
+                    lt_requests,
+                )
+                int8_tokens = {i: done8[i].tokens for i in done8}
+                free8 = reg8.gauge("serve_kv_pages_free").value()
+                mismatched = sum(
+                    1 for i in fp32_tokens
+                    if int8_tokens.get(i) != fp32_tokens[i]
+                )
+                row8 = {
+                    **_slo(reg8),
+                    "kv_dtype": "int8",
+                    "num_pages": int(pages8),
+                    "page_size": ps,
+                    "byte_budget": int(budget_bytes),
+                    "bytes_per_row": {
+                        "fp32": kv_row_bytes(spec, None),
+                        "int8": kv_row_bytes(spec, "int8"),
+                    },
+                    "completed_ok": sum(
+                        1 for c in done8.values() if c.status == "ok"
+                    ),
+                    "requests": len(lt_requests),
+                    "kv_pages_free": free8,
+                    "kv_pages_shared": reg8.gauge(
+                        "serve_kv_pages_shared").value(),
+                    "pages_free_vs_fp32":
+                        round(free8 / fp32_free, 2) if fp32_free else None,
+                    "pages_free_win_ok":
+                        bool(fp32_free and free8 >= 1.8 * fp32_free),
+                    "tokens_identical": mismatched == 0,
+                    "mismatched_requests": mismatched,
+                }
+                longtail_compare["layout_paged_int8"] = row8
+                print(f"[serve_bench] longtail int8: "
+                      f"{row8['completed_ok']}/{len(lt_requests)} ok, "
+                      f"{int(pages8)} pages for the same bytes, free "
+                      f"{free8} vs fp32 {fp32_free} "
+                      f"({row8['pages_free_vs_fp32']}x), "
+                      f"tokens_identical={row8['tokens_identical']}",
+                      file=sys.stderr)
             except Exception as e:  # noqa: BLE001
                 failed["longtail_paged"] = {"error_type": type(e).__name__,
                                             "error": str(e)[:300]}
@@ -793,6 +869,74 @@ def main() -> None:
                     for label, _, _ in arms
                 )
 
+    # -- train policy A/B with device-memory watermarks (ISSUE 19) --------
+    # One 2-step LM span per precision policy, the obs.memory sampler
+    # probed after each: on TPU the bf16-vs-fp32 peak-bytes delta is the
+    # activation-memory story; on this XLA:CPU host memory_stats() is
+    # unsupported (the sampler self-latches off — itself a pinned
+    # behavior), so the section records the A/B losses, the latch, and
+    # the TPU stub row for the next hardware window.
+    precision_memory = {}
+    if not args.skip_compare:
+        if left() < 180:
+            note = "deadline: precision_memory skipped"
+            precision_memory["skipped"] = note
+            print(f"[serve_bench] {note}", file=sys.stderr)
+        else:
+            import jax.numpy as jnp
+
+            from ddl_tpu.data.lm import synthesize_copy
+            from ddl_tpu.obs.memory import MemorySampler
+            from ddl_tpu.strategies.seq import SeqConfig, SeqTrainer
+
+            tiny = LMSpec(vocab=args.vocab, d_model=64, num_heads=4,
+                          num_layers=2, d_ff=128)
+            ds = synthesize_copy(num_train=8, num_test=4, seq_len=32,
+                                 vocab=args.vocab, seed=9)
+            for pol in ("fp32", "bf16"):
+                try:
+                    tr = SeqTrainer(SeqConfig(
+                        batch_size=4, scheme="full", num_workers=1,
+                        spec=tiny, epochs=1, precision=pol), ds)
+                    xs = tr.stage_batches(ds.tokens, 2, 4)
+                    ys = tr.stage_batches(ds.targets, 2, 4)
+                    ws = tr.stage_batches(ds.weights, 2, 4)
+                    out_span = tr.span_program(2)(
+                        tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0)
+                    )
+                    reg = MetricRegistry()
+                    sampler = MemorySampler(reg, jax.devices())
+                    supported = sampler.sample()
+                    row = {"loss": round(float(out_span[2]), 6),
+                           "device_memory_supported": bool(supported)}
+                    if supported:
+                        for nm in ("device_memory_bytes_in_use",
+                                   "device_memory_peak_bytes",
+                                   "device_memory_bytes_limit"):
+                            g = reg.get(nm)
+                            if g is not None:
+                                row[nm] = {
+                                    str(ls["device"]): g.value(**ls)
+                                    for ls in g.label_sets()
+                                }
+                    precision_memory[pol] = row
+                    print(f"[serve_bench] precision {pol}: loss "
+                          f"{row['loss']}, device_memory_supported="
+                          f"{row['device_memory_supported']}",
+                          file=sys.stderr)
+                except Exception as e:  # noqa: BLE001
+                    failed[f"precision_{pol}"] = {
+                        "error_type": type(e).__name__,
+                        "error": str(e)[:300],
+                    }
+            precision_memory["tpu_stub"] = {
+                "device_memory_peak_bytes": "not yet measured",
+                "train_mfu_fp32_vs_bf16": "not yet measured",
+                "note": "XLA:CPU reports no memory_stats(); the "
+                        "bf16-vs-fp32 peak-bytes and MFU deltas are "
+                        "TPU rows for the next hardware window",
+            }
+
     for tp in args.tensor_parallel:
         for slots in args.slots:
             tag = f"tp{tp}_slots{slots}"
@@ -872,6 +1016,7 @@ def main() -> None:
         "router_compare": router_compare,
         "fleet_compare": fleet_compare,
         "disagg_compare": disagg_compare,
+        "precision_memory": precision_memory,
         "prefix_len": args.prefix_len,
         "prefill_chunk": args.prefill_chunk,
         "page_size": args.page_size,

@@ -98,6 +98,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fp32", action="store_true",
                    help="force fp32 compute (strict reference-numerics "
                         "parity; the default off-TPU)")
+    p.add_argument("--precision", default=None, choices=["fp32", "bf16"],
+                   help="first-class precision policy (ddl_tpu.precision): "
+                        "fp32 = today's programs byte-identical; bf16 = "
+                        "bf16 activations AND gradient reductions with "
+                        "fp32 master weights/Adam moments (arXiv "
+                        "2204.06514). Owns the compute dtype — mutually "
+                        "exclusive with --bf16/--fp32 (which keep their "
+                        "legacy compute-only semantics)")
+    p.add_argument("--kv-dtype", default=None, choices=["int8"],
+                   help="serve: KV-POOL storage dtype (requires "
+                        "--page-size). int8 stores pool pages as int8 "
+                        "with per-head fp32 scales — ~2x pages per HBM "
+                        "byte, half the bytes through every page "
+                        "dump/load hand-off (preemption, crash requeue, "
+                        "disagg); dequantized in the attend view")
     p.add_argument("--fused-adam", action="store_true",
                    help="use the hand-fused Pallas Adam kernel for the "
                         "sharded update (default: XLA-fused; see "
@@ -555,11 +570,29 @@ def _int_tuple(text: str) -> tuple[int, ...]:
         )
 
 
+def _resolve_precision(args) -> str | None:
+    """The --precision policy name (None = legacy compute_dtype
+    thread). A policy plus a legacy dtype flag is rejected here with
+    the CLI's own exit, mirroring precision.resolve's conflict rule."""
+    prec = getattr(args, "precision", None)
+    if prec is not None and (args.bf16 or args.fp32):
+        raise SystemExit(
+            "--precision owns the compute dtype; drop --bf16/--fp32"
+        )
+    return prec
+
+
 def _resolve_dtype(args) -> str | None:
     """Compute dtype: explicit flags win; otherwise bf16 on TPU (the MXU
     runs bf16 at ~2x fp32 throughput and the model's accuracy is
     insensitive — BASELINE.md records matching targets either way) and
-    fp32 elsewhere (strict parity with the reference's fp32 numerics)."""
+    fp32 elsewhere (strict parity with the reference's fp32 numerics).
+    With --precision set the POLICY owns the compute dtype — this
+    resolver returns None so the config's precision.resolve sees no
+    conflicting legacy thread (the TPU auto-default included: an fp32
+    policy on TPU must stay fp32)."""
+    if _resolve_precision(args) is not None:
+        return None
     if args.bf16 and args.fp32:
         raise SystemExit("--bf16 and --fp32 are mutually exclusive")
     if args.bf16:
@@ -646,6 +679,7 @@ def config_from_args(args) -> "TrainConfig":
         shard_data=shard_data,
         staleness_seed=args.staleness_seed,
         compute_dtype=_resolve_dtype(args),
+        precision=_resolve_precision(args),
         fused_adam=args.fused_adam,
         conv1_matmul=args.conv1_matmul,
         conv_matmul=args.conv_matmul,
@@ -1023,6 +1057,7 @@ def _run_lm(args) -> int:
         tensor_parallel=args.tensor_parallel,
         scheme=scheme,
         compute_dtype=_resolve_dtype(args),
+        precision=_resolve_precision(args),
         target_accuracy=args.target_accuracy,
         zero1=args.zero1,
         attn_impl=args.attn_impl,
@@ -1552,6 +1587,13 @@ def _run_serve(args) -> int:
             spec_k, spec_method = _parse_speculate(args.speculate)
         except ValueError as e:
             raise SystemExit(f"--speculate: {e}")
+    # The engine has no optimizer boundary, so a precision POLICY here
+    # degenerates to its compute dtype ("bf16" -> bfloat16 matmuls,
+    # "fp32" -> strict fp32 even on TPU); kv_dtype is the serve-side
+    # storage knob the policy does not own.
+    prec = _resolve_precision(args)
+    serve_dtype = ("bfloat16" if prec == "bf16"
+                   else None if prec == "fp32" else _resolve_dtype(args))
     cfg = ServeConfig(
         spec=spec,
         slots=args.slots,
@@ -1560,7 +1602,8 @@ def _run_serve(args) -> int:
         temperature=args.temperature,
         top_k=args.top_k,
         seed=args.seed,
-        compute_dtype=_resolve_dtype(args),
+        compute_dtype=serve_dtype,
+        kv_dtype=args.kv_dtype,
         prefix_slots=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
         prefill_budget=args.prefill_budget,
@@ -2009,7 +2052,8 @@ def main(argv: list[str] | None = None) -> int:
                         result.train_time_s,
                         max(1, cfg.num_workers),
                         _cost.peak_flops_per_device(
-                            jax.devices()[0], args.peak_flops
+                            jax.devices()[0], args.peak_flops,
+                            precision=cfg.policy().mfu_kind,
                         ),
                     ))
     except AcceleratorTimeout as e:

@@ -351,7 +351,9 @@ def apply_lm_paged(
     flat_rows: jax.Array,
     compute_dtype=None,
     row_reduce=None,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    pool_k_scale: jax.Array | None = None,
+    pool_v_scale: jax.Array | None = None,
+) -> tuple[jax.Array, ...]:
     """Incremental forward against the PAGED (block-table) KV pool — the
     same layer math as :func:`apply_lm_cached`, with the per-slot ring
     replaced by one shared pool read/written through a block table:
@@ -374,9 +376,26 @@ def apply_lm_paged(
     padding contributes exactly 0 (verified on this backend; pinned
     paged ≡ contiguous through the whole serving stack in
     tests/test_serve_paged.py). Never differentiated; ``row_reduce`` is
-    the same Megatron ``g`` hook as :func:`apply_lm_cached`."""
+    the same Megatron ``g`` hook as :func:`apply_lm_cached`.
+
+    **Int8 pool** (ISSUE 19, ``ServeConfig.kv_dtype``): passing the
+    per-head fp32 scale planes ``pool_k_scale``/``pool_v_scale [L, P,
+    page, H]`` switches the storage path — fresh rows quantize on write
+    (``ops.kv_cache.quantize_rows``: per-head absmax, int8 payload +
+    fp32 scale), the gathered attend view dequantizes back to the
+    compute dtype, and the return grows to ``(logits, pool_k, pool_v,
+    pool_pos, pool_k_scale, pool_v_scale)``. The branch is STATIC
+    (scales are a trace-time ``None`` check), so the fp32/bf16 program
+    is byte-identical with the feature off. Quantization error enters
+    ONLY through the attend's K/V operands — masking, positions and
+    the layer math are untouched, and a row read back dequantizes to
+    the same values on every reader (sharing/hand-off stay bit-exact
+    because the bytes themselves travel)."""
     from ..ops import kv_cache
 
+    if (pool_k_scale is None) != (pool_v_scale is None):
+        raise ValueError("pass both pool_k_scale and pool_v_scale or neither")
+    quantized = pool_k_scale is not None
     if compute_dtype is not None:
         params = jax.tree.map(lambda p: p.astype(compute_dtype), dict(params))
     h = params["embed"][tokens]  # [B, T, E]
@@ -393,18 +412,33 @@ def apply_lm_paged(
         q = rope(heads(x @ blk["wq"]), positions, spec.rope_base)
         k = rope(heads(x @ blk["wk"]), positions, spec.rope_base)
         v = heads(x @ blk["wv"])
-        ck = kv_cache.write_rows_flat(pool_k[i], k.astype(pool_k.dtype),
-                                      flat_rows)
-        cv = kv_cache.write_rows_flat(pool_v[i], v.astype(pool_v.dtype),
-                                      flat_rows)
+        if quantized:
+            kq, ks = kv_cache.quantize_rows(k)
+            vq, vs = kv_cache.quantize_rows(v)
+            ck = kv_cache.write_rows_flat(pool_k[i], kq, flat_rows)
+            cv = kv_cache.write_rows_flat(pool_v[i], vq, flat_rows)
+            cks = kv_cache.write_rows_flat(pool_k_scale[i], ks, flat_rows)
+            cvs = kv_cache.write_rows_flat(pool_v_scale[i], vs, flat_rows)
+            pool_k_scale = pool_k_scale.at[i].set(cks)
+            pool_v_scale = pool_v_scale.at[i].set(cvs)
+            k_view = kv_cache.dequantize_rows(
+                kv_cache.gather_pages(ck, table),
+                kv_cache.gather_pages(cks, table), q.dtype,
+            )
+            v_view = kv_cache.dequantize_rows(
+                kv_cache.gather_pages(cv, table),
+                kv_cache.gather_pages(cvs, table), q.dtype,
+            )
+        else:
+            ck = kv_cache.write_rows_flat(pool_k[i], k.astype(pool_k.dtype),
+                                          flat_rows)
+            cv = kv_cache.write_rows_flat(pool_v[i], v.astype(pool_v.dtype),
+                                          flat_rows)
+            k_view = kv_cache.gather_pages(ck, table).astype(q.dtype)
+            v_view = kv_cache.gather_pages(cv, table).astype(q.dtype)
         pool_k = pool_k.at[i].set(ck)
         pool_v = pool_v.at[i].set(cv)
-        a = kv_cache.attend(
-            q,
-            kv_cache.gather_pages(ck, table).astype(q.dtype),
-            kv_cache.gather_pages(cv, table).astype(q.dtype),
-            positions, k_pos,
-        )
+        a = kv_cache.attend(q, k_view, v_view, positions, k_pos)
         h = h + reduce_(a.reshape(b, t, -1) @ blk["wo"])
         x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
         h = h + reduce_(
@@ -413,6 +447,9 @@ def apply_lm_paged(
 
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])
     logits = (h @ params["head"]).astype(jnp.float32)
+    if quantized:
+        return (logits, pool_k, pool_v, pool_pos,
+                pool_k_scale, pool_v_scale)
     return logits, pool_k, pool_v, pool_pos
 
 

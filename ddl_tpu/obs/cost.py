@@ -39,11 +39,22 @@ test oracle and this module can only disagree by a real bug):
   IS the paged layout's perf story, so the gauge must show it.
 
 Peak FLOP/s come from :data:`PEAK_FLOPS_BY_KIND` (per-chip dense
-bf16/fp32 marketing peaks, matched on the JAX ``device_kind`` string)
+**bf16** marketing peaks, matched on the JAX ``device_kind`` string)
 with a ``--peak-flops`` override; unknown kinds (including CPU) fall
 back to :data:`CPU_NOMINAL_PEAK_FLOPS` so CPU runs still produce a
 number — an order-of-magnitude anchor, clearly not a measured roofline
 (override it for real CPU studies).
+
+**Precision-aware denominator** (ISSUE 19): the table rows are bf16
+peaks, but an fp32 run's matmuls cannot reach them — TPU MXUs run fp32
+at half the bf16 rate, so scoring an fp32 run against the bf16 peak
+flatters its MFU ~2x. ``peak_flops_per_device(precision=)`` takes the
+active precision policy's matmul row (``PrecisionPolicy.mfu_kind`` —
+"bf16" or "fp32") and halves the TPU table entry for fp32
+(:data:`FP32_PEAK_FRACTION`). The CPU nominal is NOT halved — it is an
+fp32-ish anchor already, so every committed CPU artifact is unchanged.
+The trainers and the serve scheduler plumb their resolved policy in;
+the default keeps the historical bf16 anchoring for direct callers.
 """
 
 from __future__ import annotations
@@ -65,18 +76,32 @@ PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
 # a real number.
 CPU_NOMINAL_PEAK_FLOPS = 5e10
 
+# TPU MXU fp32 throughput as a fraction of the bf16 peak: fp32 matmuls
+# run the same systolic array at half rate on every generation in the
+# table above, so an fp32-policy run divides the bf16 row by 2.
+FP32_PEAK_FRACTION = 0.5
+
 
 _warned_kinds: set = set()
 
 
-def peak_flops_per_device(device=None, override: float | None = None
-                          ) -> float:
-    """Peak FLOP/s for one device: ``override`` wins; else the
-    ``device_kind`` table; else the CPU nominal fallback. An
-    ACCELERATOR kind the table doesn't know (a new TPU generation, a
-    GPU) warns once per kind — silently anchoring its MFU to the CPU
-    nominal would report utilizations orders of magnitude above 1.0 as
-    if they were real."""
+def peak_flops_per_device(device=None, override: float | None = None,
+                          precision: str = "bf16") -> float:
+    """Peak FLOP/s for one device at the given matmul ``precision``
+    ("bf16" or "fp32" — the resolved policy's ``mfu_kind``):
+    ``override`` wins (taken as the peak at the ACTIVE precision — the
+    operator pinning a roofline pins the one their run can reach); else
+    the ``device_kind`` table (bf16 rows, halved for fp32 per
+    :data:`FP32_PEAK_FRACTION`); else the CPU nominal fallback
+    (precision-independent — it is an fp32-ish anchor). An ACCELERATOR
+    kind the table doesn't know (a new TPU generation, a GPU) warns
+    once per kind — silently anchoring its MFU to the CPU nominal
+    would report utilizations orders of magnitude above 1.0 as if they
+    were real."""
+    if precision not in ("bf16", "fp32"):
+        raise ValueError(
+            f"unknown peak precision {precision!r} (bf16 or fp32)"
+        )
     if override is not None:
         if override <= 0:
             raise ValueError(f"peak flops override must be > 0, got "
@@ -87,7 +112,8 @@ def peak_flops_per_device(device=None, override: float | None = None
         kind = str(getattr(device, "device_kind", "")).lower()
     for key, peak in PEAK_FLOPS_BY_KIND:
         if key in kind:
-            return peak
+            return peak * (FP32_PEAK_FRACTION if precision == "fp32"
+                           else 1.0)
     platform = str(getattr(device, "platform", "cpu")).lower()
     if platform != "cpu" and kind not in _warned_kinds:
         import warnings

@@ -145,6 +145,35 @@ def table_positions(pos: jax.Array, table: jax.Array) -> jax.Array:
     return g.reshape(g.shape[0], -1)
 
 
+def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-head int8 quantization of fresh K/V rows
+    ``x [..., D]`` -> ``(q int8 [..., D], scale fp32 [...])`` — the
+    write half of the int8 KV pool (ISSUE 19, ``ServeConfig.kv_dtype``).
+    One absmax scale per HEAD VECTOR (the trailing ``D`` axis): ``scale
+    = amax / 127`` (1.0 for an all-zero row, so dequant stays finite and
+    exact), values rounded to nearest and clipped to ``[-127, 127]``.
+    Per-head scaling keeps the quantizer LOCAL to a head: each tp
+    shard holds whole heads, so quantizing needs no cross-shard
+    reduction and a stored (payload, scale) pair round-trips
+    bit-identically through any dump/load hand-off at its own tp.
+    Quantization happens in fp32 regardless of compute dtype (a bf16
+    amax would move stored bytes between precision policies)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of :func:`quantize_rows` for the gathered attend view:
+    ``q int8 [..., D]`` times its per-head ``scale [...]``, multiplied
+    in fp32 (exact — int8 payloads and fp32 scales are both fp32-
+    representable) then cast to the attend's compute ``dtype``."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
 def write_rows_flat(pool: jax.Array, new: jax.Array,
                     flat: jax.Array) -> jax.Array:
     """Write ``new [B, T, ...]`` into ``pool [pages, page_size, ...]``

@@ -143,6 +143,12 @@ def make_pipeline_step_body(config, part, tables, platform, *, lr,
     f_tab, b_tab = tables
     pp = part.pp
     m = int(f_tab.max()) + 1
+    # Precision policy (ddl_tpu.precision): under "bf16" the step-end
+    # gradient psums move bf16 bytes and the Adam boundary upcasts to
+    # fp32 (master weights + m/v stay fp32); both hooks are
+    # Python-level no-ops for fp32/legacy configs — the exact
+    # pre-policy program.
+    pol = config.policy()
     from .schedule import buffer_slots
 
     slots = buffer_slots(f_tab, b_tab)
@@ -240,11 +246,13 @@ def make_pipeline_step_body(config, part, tables, platform, *, lr,
         (_, _, _, gacc, num_acc), _ = lax.scan(tick, carry0, cols)
 
         loss = lax.psum(num_acc, AXES + (PP_AXIS,)) * inv_den
+        gacc = pol.cast_grads(gacc)
         grads = {
             k: (lax.psum(g, AXES + (PP_AXIS,)) if k in SHARED_LEAVES
                 else jax.tree.map(lambda a: lax.psum(a, AXES), g))
             for k, g in gacc.items()
         }
+        grads = pol.upcast_grads(grads)
         new_params, new_opt = adam_update(params, opt_state, grads, lr=lr)
         out = ()
         if guard or health:

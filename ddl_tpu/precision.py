@@ -1,0 +1,184 @@
+"""The precision policy: one named contract for every dtype decision.
+
+Mixed precision in this repo was, before this module, a scattering of
+``compute_dtype`` threads — each trainer resolved the string itself and
+cast params/activations inside the loss (models/cnn.py,
+models/transformer.py). That mechanism is already *half* of the
+bf16-compute/fp32-master recipe the pjit/TPUv4 LM-scaling work trains
+with (PAPERS.md 2204.06514): the in-loss ``params.astype(bf16)`` cast
+means the matmuls run on the MXU fast path, and — because
+``convert_element_type``'s transpose upcasts cotangents — the gradients
+that reach the optimizer are ALREADY fp32 leaves against fp32 master
+weights. What it does not do is say so anywhere, and it leaves the one
+distributed lever on the table: cross-device gradient *reduction* still
+moves fp32 bytes.
+
+:class:`PrecisionPolicy` makes the contract first-class:
+
+- ``policy("fp32")`` — every hook is a Python-level no-op, so each step
+  body compiles the byte-identical pre-policy program (the repo's
+  standard off-path discipline; pinned by tests/test_precision.py HLO
+  text comparisons).
+- ``policy("bf16")`` — bf16 activations and gradients with fp32 master
+  weights and Adam moments: the forward/backward casts ride the
+  existing ``compute_dtype`` thread, while :meth:`cast_grads` /
+  :meth:`upcast_grads` bracket each step body's explicit gradient
+  reduction (``psum`` / ``psum_scatter``) so the wire moves bf16 and
+  the optimizer boundary upcasts back to fp32 — halved collective
+  bytes, fp32 Adam math, per arXiv 2204.06514's recipe.
+
+Casting follows the shard/gather dtype-casting shape of SNIPPETS.md
+[1]'s ``make_to_dtype_fn``: only FLOAT leaves convert; integer leaves
+(step counters, token ids) pass through untouched
+(:func:`make_to_dtype_fn`).
+
+Numerics that stay fp32 under EVERY policy (the boundaries the README
+section documents): LayerNorm statistics (``transformer._layernorm``
+computes in fp32 internally), logits and the loss (both model families
+``.astype(jnp.float32)`` the head output), master weights, and Adam
+``m``/``v`` — which is also why checkpoints are policy-elastic: a
+``bf16`` run saves the same fp32 arrays an ``fp32`` run does
+(utils/checkpoint.py now pins the dtypes loudly at load).
+
+Serving has its own storage-side policy knob, ``ServeConfig.kv_dtype``
+(int8 KV pool with per-head scales — serve/cache.py); :func:`mfu_kind`
+here is the shared translator from either knob to the MFU peak table's
+precision row (obs/cost.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+POLICIES = ("fp32", "bf16")
+
+# compute-dtype string (the legacy config field) per policy name.
+_COMPUTE = {"fp32": None, "bf16": "bfloat16"}
+
+_FLOAT_KINDS = ("f", "V")  # V: bfloat16 registers as void on old numpy
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype if not hasattr(x, "dtype")
+                          else x.dtype, jnp.floating)
+
+
+def make_to_dtype_fn(dtype):
+    """A per-leaf caster in the shape of SNIPPETS.md [1]'s
+    ``make_to_dtype_fn``: float leaves convert to ``dtype``, everything
+    else (ints, bools — step counters, token ids) passes through
+    untouched. ``dtype=None`` is the identity."""
+    if dtype is None:
+        return lambda x: x
+
+    def to_dtype(x):
+        return x.astype(dtype) if _is_float(x) else x
+
+    return to_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One resolved precision contract (see the module docstring).
+
+    ``name`` is ``"fp32"`` or ``"bf16"``; ``legacy`` marks a policy
+    derived from a bare ``compute_dtype="bfloat16"`` config (pre-policy
+    behavior: bf16 compute but fp32 gradient reductions — kept
+    byte-identical so existing bf16 runs and their pins do not move)."""
+
+    name: str
+    legacy: bool = False
+
+    @property
+    def compute_dtype(self):
+        """The jnp dtype the models cast params/activations to (None =
+        fp32 — the models' no-cast path)."""
+        s = _COMPUTE[self.name]
+        return None if s is None else jnp.dtype(s)
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.name == "bf16"
+
+    @property
+    def reduces_in_bf16(self) -> bool:
+        """Whether the step bodies cast gradients to bf16 before their
+        cross-device reduction (the distributed perf lever). False for
+        fp32 AND for legacy bf16 configs — both compile pre-policy
+        programs."""
+        return self.is_mixed and not self.legacy
+
+    @property
+    def mfu_kind(self) -> str:
+        """The peak-FLOPs precision row this policy's matmuls run at
+        (obs/cost.py ``peak_flops_per_device(precision=)``)."""
+        return "bf16" if self.compute_dtype is not None else "fp32"
+
+    def cast_grads(self, tree):
+        """Gradients -> the wire dtype, applied immediately BEFORE the
+        step body's explicit reduction. Python-level identity off-path,
+        so fp32/legacy programs are untouched."""
+        if not self.reduces_in_bf16:
+            return tree
+        return jax.tree.map(make_to_dtype_fn(jnp.bfloat16), tree)
+
+    def upcast_grads(self, tree):
+        """Reduced gradients -> fp32 at the optimizer boundary (Adam
+        math and master weights stay fp32 under every policy).
+        Python-level identity off-path."""
+        if not self.reduces_in_bf16:
+            return tree
+        return jax.tree.map(make_to_dtype_fn(jnp.float32), tree)
+
+
+def resolve(precision: str | None, compute_dtype: str | None
+            ) -> PrecisionPolicy:
+    """The ONE resolution rule every config's ``.policy()`` delegates
+    to, reconciling the new ``precision`` field with the legacy
+    ``compute_dtype`` thread:
+
+    - ``precision=None, compute_dtype=None`` -> fp32 (today's default,
+      byte-identical programs);
+    - ``precision=None, compute_dtype="bfloat16"`` -> LEGACY bf16:
+      compute casts exactly as before, gradient reductions stay fp32 —
+      pre-policy configs keep compiling their pre-policy programs;
+    - ``precision="fp32"|"bf16"`` -> the named policy; a conflicting
+      ``compute_dtype`` raises (two knobs silently disagreeing about
+      the matmul dtype would mislabel every measurement downstream).
+    """
+    if precision is None:
+        if compute_dtype is None:
+            return PrecisionPolicy("fp32")
+        if jnp.dtype(compute_dtype) == jnp.bfloat16:
+            return PrecisionPolicy("bf16", legacy=True)
+        if jnp.dtype(compute_dtype) == jnp.float32:
+            return PrecisionPolicy("fp32")
+        raise ValueError(
+            f"unsupported compute_dtype {compute_dtype!r} (fp32 or "
+            "bfloat16; int8 is a KV-STORAGE dtype — ServeConfig.kv_dtype)"
+        )
+    if precision not in POLICIES:
+        raise ValueError(
+            f"unknown precision policy {precision!r} "
+            f"(choices: {', '.join(POLICIES)})"
+        )
+    want = _COMPUTE[precision]
+    if compute_dtype is not None and (
+            want is None or jnp.dtype(compute_dtype) != jnp.dtype(want)):
+        raise ValueError(
+            f"precision={precision!r} conflicts with "
+            f"compute_dtype={compute_dtype!r}: the policy owns the "
+            "compute dtype — drop the compute_dtype flag"
+        )
+    return PrecisionPolicy(precision)
+
+
+def mfu_kind(compute_dtype: str | None) -> str:
+    """Legacy-thread translator for call sites that only hold a
+    compute_dtype string (the serve scheduler): the MFU precision row
+    those matmuls run at."""
+    return ("bf16" if compute_dtype is not None
+            and jnp.dtype(compute_dtype) == jnp.bfloat16 else "fp32")

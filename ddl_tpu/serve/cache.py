@@ -142,11 +142,25 @@ class PagedKVCache:
       ``PAD_POS`` (pages reset when their last reference drops), so a
       freshly mapped page can never leak its previous occupant's
       positions into the gathered attend view.
+    - ``k_scale``/``v_scale [num_layers, num_pages, page_size,
+      num_heads]`` — per-head fp32 dequantization scales, present ONLY
+      when the pool stores int8 payloads (``ServeConfig.kv_dtype ==
+      "int8"``, ISSUE 19): row ``r`` of head ``h`` dequantizes as
+      ``k[..., r, h, :] * k_scale[..., r, h]``
+      (``ops.kv_cache.dequantize_rows``). ``None`` (the fp32/bf16
+      default) is an EMPTY pytree node — the tree flattens to exactly
+      the three historical leaves, so every off-path program (specs,
+      donation, HLO) is byte-identical to the pre-int8 pool. Scales
+      travel WITH their pages through every page motion (CoW copy,
+      cross-replica write, dump/load), so sharing, preemption and
+      disagg hand-off stay bit-exact.
     """
 
-    k: jax.Array  # [L, P, page, H, D]
+    k: jax.Array  # [L, P, page, H, D] (fp32/bf16, or int8 when quantized)
     v: jax.Array  # [L, P, page, H, D]
     pos: jax.Array  # [P, page] int32, PAD_POS = unwritten
+    k_scale: jax.Array | None = None  # [L, P, page, H] fp32, int8 pools only
+    v_scale: jax.Array | None = None  # [L, P, page, H] fp32, int8 pools only
 
     @property
     def num_pages(self) -> int:
@@ -158,27 +172,67 @@ class PagedKVCache:
 
 
 def host_paged_cache(
-    spec: LMSpec, num_pages: int, page_size: int, dtype=np.float32
+    spec: LMSpec, num_pages: int, page_size: int, dtype=np.float32,
+    *, kv_dtype: str | None = None
 ) -> PagedKVCache:
     """Fresh host-side paged pool: zero k/v, every row ``PAD_POS`` (the
     free-list invariant holds from birth). Placed with
-    ``multihost.put_tree(mesh, paged_cache_specs(tp), ...)``."""
+    ``multihost.put_tree(mesh, paged_cache_specs(tp), ...)``.
+    ``kv_dtype="int8"`` stores int8 payloads plus per-head fp32 scale
+    planes (initialized to 1.0 — dequant of the zero payload is an
+    exact 0.0); ``None`` keeps the historical ``dtype`` pool with NO
+    scale leaves."""
     shape = (spec.num_layers, num_pages, page_size,
              spec.num_heads, spec.head_dim)
+    if kv_dtype is None:
+        return PagedKVCache(
+            k=np.zeros(shape, dtype),
+            v=np.zeros(shape, dtype),
+            pos=np.full((num_pages, page_size), PAD_POS, np.int32),
+        )
+    if kv_dtype != "int8":
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
     return PagedKVCache(
-        k=np.zeros(shape, dtype),
-        v=np.zeros(shape, dtype),
+        k=np.zeros(shape, np.int8),
+        v=np.zeros(shape, np.int8),
         pos=np.full((num_pages, page_size), PAD_POS, np.int32),
+        k_scale=np.ones(shape[:4], np.float32),
+        v_scale=np.ones(shape[:4], np.float32),
     )
 
 
-def paged_cache_specs(tensor_parallel: int) -> PagedKVCache:
+def paged_cache_specs(tensor_parallel: int, *,
+                      kv_dtype: str | None = None) -> PagedKVCache:
     """PartitionSpec pytree for the paged pool: same head-dim tp
     sharding as :func:`cache_specs` (the pool's page axis is a memory
-    axis, never a mesh axis); ``pos`` replicated."""
+    axis, never a mesh axis); ``pos`` replicated. Int8 pools shard the
+    scale planes over their HEAD axis (axis 3 of ``[L, P, page, H]``)
+    exactly like the payloads they rescale — a page and its scales
+    always live on the same tp member."""
     kv = (P(None, None, None, TP_AXIS, None)
           if tensor_parallel > 1 else P())
-    return PagedKVCache(k=kv, v=kv, pos=P())
+    if kv_dtype is None:
+        return PagedKVCache(k=kv, v=kv, pos=P())
+    sc = P(None, None, None, TP_AXIS) if tensor_parallel > 1 else P()
+    return PagedKVCache(k=kv, v=kv, pos=P(), k_scale=sc, v_scale=sc)
+
+
+def kv_row_bytes(spec: LMSpec, kv_dtype: str | None,
+                 dtype=np.float32) -> int:
+    """Bytes ONE pool row (K + V of every layer, scales included) costs
+    on device — the byte-envelope arithmetic the int8 pool trades on:
+    fp32 stores ``2 * L * H * D * 4`` bytes/row, int8 ``2 * L * H * (D
+    + 4)`` (one int8 per element plus one fp32 scale per head), a
+    ``4D / (D + 4)``x compression — 3.2x at head_dim 16, approaching 4x
+    as heads widen. ``benchmarks/serve_bench.py`` sizes its int8 arm's
+    ``num_pages`` from this so both arms spend the SAME byte budget and
+    the free-page headroom becomes the measured win."""
+    per_elem = 2 * spec.num_layers * spec.num_heads
+    if kv_dtype is None:
+        return per_elem * spec.head_dim * np.dtype(dtype).itemsize
+    if kv_dtype != "int8":
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+    return per_elem * (spec.head_dim + np.dtype(np.float32).itemsize)
 
 
 def copy_page(
@@ -195,7 +249,9 @@ def copy_page(
     tail rows); every full page is shared by table mapping, zero-copy.
     Destination rows ``>= n`` reset to ``PAD_POS`` (the free-list
     invariant for the fresh page). All indices traced — one compiled
-    program. Head-dim tp sharding is row-local: no collective needed."""
+    program. Head-dim tp sharding is row-local: no collective needed.
+    Int8 pools copy the per-head scale rows alongside their payload —
+    a copied row dequantizes bit-identically to its source."""
     sk = lax.dynamic_slice_in_dim(pool.k, src_page, 1, axis=1)
     sv = lax.dynamic_slice_in_dim(pool.v, src_page, 1, axis=1)
     sp = lax.dynamic_slice_in_dim(pool.pos, src_page, 1, axis=0)
@@ -205,7 +261,8 @@ def copy_page(
     new_pos = jnp.where(rows < n, sp[0], PAD_POS)[None, :].astype(
         pool.pos.dtype
     )
-    return PagedKVCache(
+    out = dataclasses.replace(
+        pool,
         k=lax.dynamic_update_slice_in_dim(
             pool.k, copy_prefix(dk, sk, n, axis=2), dst_page, axis=1
         ),
@@ -214,6 +271,23 @@ def copy_page(
         ),
         pos=lax.dynamic_update_slice_in_dim(
             pool.pos, new_pos, dst_page, axis=0
+        ),
+    )
+    if pool.k_scale is None:
+        return out
+    sks = lax.dynamic_slice_in_dim(pool.k_scale, src_page, 1, axis=1)
+    svs = lax.dynamic_slice_in_dim(pool.v_scale, src_page, 1, axis=1)
+    dks = lax.dynamic_slice_in_dim(pool.k_scale, dst_page, 1, axis=1)
+    dvs = lax.dynamic_slice_in_dim(pool.v_scale, dst_page, 1, axis=1)
+    return dataclasses.replace(
+        out,
+        k_scale=lax.dynamic_update_slice_in_dim(
+            pool.k_scale, copy_prefix(dks, sks, n, axis=2), dst_page,
+            axis=1,
+        ),
+        v_scale=lax.dynamic_update_slice_in_dim(
+            pool.v_scale, copy_prefix(dvs, svs, n, axis=2), dst_page,
+            axis=1,
         ),
     )
 
@@ -225,6 +299,8 @@ def write_page(
     k_rows: jax.Array,
     v_rows: jax.Array,
     pos_rows: jax.Array,
+    k_scale_rows: jax.Array | None = None,
+    v_scale_rows: jax.Array | None = None,
 ) -> PagedKVCache:
     """Overwrite ``dst_page`` of the pool with caller-supplied rows (K/V
     of every layer + positions) — the receive half of the cross-replica
@@ -237,12 +313,27 @@ def write_page(
     ``PAD_POS`` tail, so the free-list invariant survives the write. The
     page id is traced — ONE compiled program covers every transfer;
     head-dim tp sharding is row-local (the rows arrive sharded the same
-    way), no collective needed."""
-    return PagedKVCache(
+    way), no collective needed. Int8 pools receive the page's per-head
+    ``*_scale_rows [L, 1, page, H]`` too — payload bytes without their
+    scales would dequantize to the wrong values, so the hand-off moves
+    both or neither (the engine's dump/load keeps them paired)."""
+    out = dataclasses.replace(
+        pool,
         k=lax.dynamic_update_slice_in_dim(pool.k, k_rows, dst_page, axis=1),
         v=lax.dynamic_update_slice_in_dim(pool.v, v_rows, dst_page, axis=1),
         pos=lax.dynamic_update_slice_in_dim(
             pool.pos, pos_rows, dst_page, axis=0
+        ),
+    )
+    if k_scale_rows is None:
+        return out
+    return dataclasses.replace(
+        out,
+        k_scale=lax.dynamic_update_slice_in_dim(
+            pool.k_scale, k_scale_rows, dst_page, axis=1
+        ),
+        v_scale=lax.dynamic_update_slice_in_dim(
+            pool.v_scale, v_scale_rows, dst_page, axis=1
         ),
     )
 

@@ -136,7 +136,22 @@ class ServeConfig:
     memory envelope, no pooling savings but drop-in. The contiguous
     path (``page_size = 0``, the default) is retained as the
     bit-exactness oracle: paged decode is PINNED bit-identical to it
-    (tests/test_serve_paged.py)."""
+    (tests/test_serve_paged.py).
+
+    ``kv_dtype = "int8"`` (paged layout only; ISSUE 19) stores the pool
+    as int8 payloads plus per-head fp32 scales
+    (``serve.cache.PagedKVCache.k_scale``): rows quantize on page write
+    and dequantize in the gathered attend view
+    (``ops.kv_cache.quantize_rows``/``dequantize_rows``), cutting pool
+    bytes ``4 * head_dim / (head_dim + 4)``-fold (3.2x at head_dim 16)
+    so the SAME byte budget holds more pages — more admission headroom,
+    more FREE-slot draft lanes for speculation. Scales travel WITH
+    their pages through ``dump_slot_pages``/``load_slot_pages`` (as
+    ``(payload, scale)`` pairs the host side passes through opaquely),
+    so preempt/adopt, crash requeue and the disagg hand-off all move
+    the compressed bytes and resume bit-exactly. ``None`` (default)
+    keeps the fp32/bf16 pool — the compiled programs are byte-identical
+    to pre-int8 builds (HLO-pinned in tests/test_precision.py)."""
 
     spec: LMSpec = LMSpec()
     slots: int = 4
@@ -151,6 +166,7 @@ class ServeConfig:
     prefill_budget: int = 0  # prefill tokens per scheduler tick; 0 = all
     page_size: int = 0  # paged KV layout: rows per page; 0 = contiguous
     num_pages: int = 0  # paged pool size; 0 = slots * capacity / page_size
+    kv_dtype: str | None = None  # "int8" = quantized paged pool; None = full
     # Speculative decoding (ISSUE 15, serve.speculate): k > 0 drafts up
     # to k tokens per active slot per tick and verifies them through
     # FREE SLOTS of the one batched decode call (zero new programs —
@@ -271,6 +287,20 @@ class InferenceEngine:
         if config.num_pages < 0:
             raise ValueError(f"num_pages must be >= 0, got {config.num_pages}")
         self.paged = ps > 0
+        # Quantized-pool config (loud-ctor discipline): int8 storage is
+        # a property of the PAGE pool — the contiguous ring is the bit-
+        # exactness oracle and stays full-precision by definition.
+        if config.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {config.kv_dtype!r}"
+            )
+        if config.kv_dtype == "int8" and not self.paged:
+            raise ValueError(
+                "kv_dtype='int8' needs the paged KV layout (page_size > "
+                "0): quantized storage lives in the shared page pool; "
+                "the contiguous ring is the full-precision oracle"
+            )
+        self.quantized = config.kv_dtype == "int8"
         # Speculation config (loud-ctor discipline): every requirement
         # is structural — a violated one could only surface as silently
         #-never-speculating or a mid-run lane failure.
@@ -359,7 +389,8 @@ class InferenceEngine:
         self._write_page_fn = None  # paged: cross-replica page hand-off
         self._reset_pages_fn = None  # paged: PAD_POS freed pages' pos
         if self.paged:
-            self._pcspecs = paged_cache_specs(tp)
+            self._pcspecs = paged_cache_specs(tp,
+                                              kv_dtype=config.kv_dtype)
         self.pool: KVCache | None = None
         self.prefix: PrefixIndex | None = None
         self.reset()
@@ -392,7 +423,8 @@ class InferenceEngine:
             self.cache = multihost.put_tree(
                 self.mesh, self._pcspecs,
                 host_paged_cache(self.config.spec, self.num_pages,
-                                 self.page_size, dtype),
+                                 self.page_size, dtype,
+                                 kv_dtype=self.config.kv_dtype),
             )
             self.pages = PagePool(self.num_pages)
             self.tables = np.full(
@@ -499,10 +531,13 @@ class InferenceEngine:
             ids = np.full(self.max_pages, self.num_pages, np.int32)
             ids[: len(batch)] = batch  # padding is out of bounds: dropped
             if self._reset_pages_fn is None:
+                # dataclasses.replace keeps any scale leaves riding
+                # along untouched — freed pages reset ONLY their pos
+                # rows (stale payloads/scales are invisible behind
+                # PAD_POS, exactly like the contiguous ring).
                 self._reset_pages_fn = jax.jit(
-                    lambda cache, pages: PagedKVCache(
-                        k=cache.k, v=cache.v,
-                        pos=cache.pos.at[pages].set(PAD_POS),
+                    lambda cache, pages: dataclasses.replace(
+                        cache, pos=cache.pos.at[pages].set(PAD_POS),
                     ),
                     donate_argnums=donation_for(self.mesh, 0),
                 )
@@ -684,6 +719,30 @@ class InferenceEngine:
 
     # -- paged compiled programs -------------------------------------------
 
+    def _paged_forward(self, params, pool: PagedKVCache, tokens, table,
+                       *, positions, flat_rows):
+        """The one ``apply_lm_paged`` call both paged programs trace:
+        routes the pool's scale planes in (and the updated planes back
+        out) when the pool is int8 — a STATIC branch on
+        ``self.quantized``, so the full-precision programs are
+        byte-identical to pre-int8 builds."""
+        cfg = self.config
+        if self.quantized:
+            logits, k, v, pos, ks, vs = transformer.apply_lm_paged(
+                params, tokens, pool.k, pool.v, pool.pos, table,
+                cfg.spec, positions=positions, flat_rows=flat_rows,
+                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+                pool_k_scale=pool.k_scale, pool_v_scale=pool.v_scale,
+            )
+            return logits, PagedKVCache(k=k, v=v, pos=pos,
+                                        k_scale=ks, v_scale=vs)
+        logits, k, v, pos = transformer.apply_lm_paged(
+            params, tokens, pool.k, pool.v, pool.pos, table, cfg.spec,
+            positions=positions, flat_rows=flat_rows,
+            compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+        )
+        return logits, PagedKVCache(k=k, v=v, pos=pos)
+
     def _prefill_paged_fn(self, bucket: int):
         """Paged prefill for prompt blocks padded to ``bucket`` tokens:
         ``(params, pool, tokens [1, bucket], length, base,
@@ -697,7 +756,6 @@ class InferenceEngine:
         cost)."""
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
-        cfg = self.config
         ps, num_pages = self.page_size, self.num_pages
         reach = self.max_pages * ps
         from ..ops import kv_cache as kvc
@@ -712,12 +770,11 @@ class InferenceEngine:
             # same drop discipline the contiguous offset prefill uses).
             logical = jnp.where(real, base + t, reach)[None, :]
             flat = kvc.table_rows(table, logical, ps, num_pages)
-            logits, k, v, pos = transformer.apply_lm_paged(
-                params, tokens, pool.k, pool.v, pool.pos, table, cfg.spec,
-                positions=positions, flat_rows=flat,
-                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+            logits, pool = self._paged_forward(
+                params, pool, tokens, table, positions=positions,
+                flat_rows=flat,
             )
-            return logits[0], PagedKVCache(k=k, v=v, pos=pos)
+            return logits[0], pool
 
         P_ = jax.sharding.PartitionSpec
         shard = jax.shard_map(
@@ -750,7 +807,6 @@ class InferenceEngine:
         bounds and DROP — a mid-prefill or free slot touches nothing."""
         if pages in self._decode_paged_fns:
             return self._decode_paged_fns[pages]
-        cfg = self.config
         ps, num_pages = self.page_size, self.num_pages
         from ..ops import kv_cache as kvc
 
@@ -758,12 +814,11 @@ class InferenceEngine:
             positions = jnp.where(active, lengths, PAD_POS)[:, None]
             logical = jnp.where(active, lengths, pages * ps)[:, None]
             flat = kvc.table_rows(table, logical, ps, num_pages)
-            logits, k, v, pos = transformer.apply_lm_paged(
-                params, last_tokens[:, None], pool.k, pool.v, pool.pos,
-                table, cfg.spec, positions=positions, flat_rows=flat,
-                compute_dtype=cfg.dtype(), row_reduce=self._row_reduce,
+            logits, pool = self._paged_forward(
+                params, pool, last_tokens[:, None], table,
+                positions=positions, flat_rows=flat,
             )
-            return logits[:, 0], PagedKVCache(k=k, v=v, pos=pos)
+            return logits[:, 0], pool
 
         P_ = jax.sharding.PartitionSpec
         shard = jax.shard_map(
@@ -817,14 +872,30 @@ class InferenceEngine:
         if self._write_page_fn is not None:
             return self._write_page_fn
 
-        def shard_body(pool, dst_page, k_rows, v_rows, pos_rows):
-            return write_page(pool, dst_page=dst_page, k_rows=k_rows,
-                              v_rows=v_rows, pos_rows=pos_rows)
+        if self.quantized:
+            def shard_body(pool, dst_page, k_rows, v_rows, pos_rows,
+                           ks_rows, vs_rows):
+                return write_page(pool, dst_page=dst_page, k_rows=k_rows,
+                                  v_rows=v_rows, pos_rows=pos_rows,
+                                  k_scale_rows=ks_rows,
+                                  v_scale_rows=vs_rows)
+
+            in_specs = (self._pcspecs, jax.sharding.PartitionSpec(),
+                        self._pcspecs.k, self._pcspecs.v,
+                        self._pcspecs.pos, self._pcspecs.k_scale,
+                        self._pcspecs.v_scale)
+        else:
+            def shard_body(pool, dst_page, k_rows, v_rows, pos_rows):
+                return write_page(pool, dst_page=dst_page, k_rows=k_rows,
+                                  v_rows=v_rows, pos_rows=pos_rows)
+
+            in_specs = (self._pcspecs, jax.sharding.PartitionSpec(),
+                        self._pcspecs.k, self._pcspecs.v,
+                        self._pcspecs.pos)
 
         shard = jax.shard_map(
             shard_body, mesh=self.mesh,
-            in_specs=(self._pcspecs, jax.sharding.PartitionSpec(),
-                      self._pcspecs.k, self._pcspecs.v, self._pcspecs.pos),
+            in_specs=in_specs,
             out_specs=self._pcspecs,
             check_vma=False,
         )
@@ -842,7 +913,14 @@ class InferenceEngine:
         gathered attend view reconstructs), assembled across tp shards
         by ``device_get``. A host round-trip moves bits, not values —
         the destination's attend view is bit-identical by
-        construction."""
+        construction.
+
+        Int8 pools return ``k``/``v`` as ``(payload, scale)`` PAIRS
+        (int8 rows + their fp32 per-head scales) — the host layers
+        (``scheduler.preempt``'s ``PreemptedRequest``, the controller,
+        the disagg coordinator) store and forward them opaquely, so the
+        hand-off moves the compressed bytes and ``load_slot_pages`` on
+        the destination reassembles the exact source rows."""
         if not self.paged:
             raise RuntimeError(
                 "dump_slot_pages needs the paged KV layout (page_size > "
@@ -851,10 +929,17 @@ class InferenceEngine:
             )
         n = int(self.table_len[slot])
         pages = jnp.asarray(self.tables[slot, :n], jnp.int32)
-        k = np.asarray(jax.device_get(jnp.take(self.cache.k, pages, axis=1)))
-        v = np.asarray(jax.device_get(jnp.take(self.cache.v, pages, axis=1)))
-        pos = np.asarray(jax.device_get(jnp.take(self.cache.pos, pages,
-                                                 axis=0)))
+
+        def take(leaf, axis):
+            return np.asarray(jax.device_get(jnp.take(leaf, pages,
+                                                      axis=axis)))
+
+        k = take(self.cache.k, 1)
+        v = take(self.cache.v, 1)
+        pos = take(self.cache.pos, 0)
+        if self.quantized:
+            return ((k, take(self.cache.k_scale, 1)),
+                    (v, take(self.cache.v_scale, 1)), pos)
         return k, v, pos
 
     def load_slot_pages(self, slot: int, k, v, pos) -> list[int]:
@@ -864,10 +949,30 @@ class InferenceEngine:
         with the serialized rows. The freshly mapped page was fully
         ``PAD_POS`` (free-list invariant) and the written ``pos`` rows
         carry the source's own ``PAD_POS`` tail, so nothing stale is
-        ever attendable. Returns the mapped page ids (table order)."""
+        ever attendable. Returns the mapped page ids (table order).
+        Int8 pools receive ``k``/``v`` as the ``(payload, scale)``
+        pairs their ``dump_slot_pages`` produced — payloads and scales
+        land together, page by page."""
         if not self.paged:
             raise RuntimeError(
                 "load_slot_pages needs the paged KV layout (page_size > 0)"
+            )
+        ks = vs = None
+        if self.quantized:
+            if not (isinstance(k, tuple) and isinstance(v, tuple)):
+                raise ValueError(
+                    "int8 pool: load_slot_pages needs the (payload, "
+                    "scale) pairs dump_slot_pages produced — a bare "
+                    "payload came from a full-precision dump and would "
+                    "dequantize to garbage"
+                )
+            k, ks = k
+            v, vs = v
+        elif isinstance(k, tuple) or isinstance(v, tuple):
+            raise ValueError(
+                "full-precision pool: load_slot_pages got (payload, "
+                "scale) pairs — the dump came from an int8 engine; "
+                "hand-offs need matching kv_dtype on both replicas"
             )
         n = int(k.shape[1])
         fn = self._write_page()
@@ -880,7 +985,15 @@ class InferenceEngine:
                                np.ascontiguousarray(v[:, i:i + 1]))
             pp = multihost.put(self.mesh, self._pcspecs.pos,
                                np.ascontiguousarray(pos[i:i + 1]))
-            self.cache = fn(self.cache, jnp.int32(page), kk, vv, pp)
+            if self.quantized:
+                kks = multihost.put(self.mesh, self._pcspecs.k_scale,
+                                    np.ascontiguousarray(ks[:, i:i + 1]))
+                vvs = multihost.put(self.mesh, self._pcspecs.v_scale,
+                                    np.ascontiguousarray(vs[:, i:i + 1]))
+                self.cache = fn(self.cache, jnp.int32(page), kk, vv, pp,
+                                kks, vvs)
+            else:
+                self.cache = fn(self.cache, jnp.int32(page), kk, vv, pp)
             mapped.append(page)
         return mapped
 

@@ -254,8 +254,8 @@ class PreemptedRequest:
     lengths: int
     admitted_at: int
     eligible_wall: float
-    k: np.ndarray  # [L, n_pages, page, H, D]
-    v: np.ndarray  # [L, n_pages, page, H, D]
+    k: np.ndarray  # [L, n_pages, page, H, D]; int8 pools: (payload, scale)
+    v: np.ndarray  # [L, n_pages, page, H, D]; int8 pools: (payload, scale)
     pos: np.ndarray  # [n_pages, page]
 
 
@@ -720,10 +720,17 @@ class Scheduler:
     def _resolve_peak(self) -> float:
         """Per-device peak FLOP/s for the serve_mfu gauge: the ctor
         override wins, else the obs.cost device-kind table (resolved
-        once)."""
+        once) at the ENGINE's matmul precision — an fp32 engine anchors
+        to the fp32 peak, not the table's bf16 row (ISSUE 19;
+        ``precision.mfu_kind`` translates the engine's compute_dtype)."""
         if self._peak is None:
+            from .. import precision as _precision
+
             self._peak = _cost.peak_flops_per_device(
-                self.engine.mesh.devices.flat[0], self._peak_flops
+                self.engine.mesh.devices.flat[0], self._peak_flops,
+                precision=_precision.mfu_kind(
+                    getattr(self.engine.config, "compute_dtype", None)
+                ),
             )
         return self._peak
 

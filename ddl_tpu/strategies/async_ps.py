@@ -153,7 +153,8 @@ def make_async_round(
     """
     W = mesh.devices.size
     spec = _flat_spec(layout, shapes)
-    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+    # Resolved precision policy owns the compute dtype (ddl_tpu.precision).
+    compute_dtype = config.policy().compute_dtype
     lr = config.learning_rate
     sharded = layout is not None
 

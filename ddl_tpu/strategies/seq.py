@@ -139,6 +139,13 @@ class SeqConfig:
     tensor_parallel: int = 1
     scheme: Scheme = "ring"
     compute_dtype: str | None = None  # None = fp32; "bfloat16" = MXU path
+    # Precision policy (ddl_tpu.precision): "fp32" (today's programs,
+    # byte-identical) or "bf16" (bf16 activations AND gradient
+    # reductions, fp32 master weights + Adam moments — arXiv
+    # 2204.06514's split). None defers to the legacy compute_dtype
+    # thread: a bare compute_dtype="bfloat16" keeps compiling its
+    # pre-policy program (bf16 compute, fp32 reductions).
+    precision: str | None = None
     target_accuracy: float | None = None
     # ZeRO-1 over the combined (dp, sp) axes: reduce-scatter grads, Adam
     # on each device's flat chunk (m/v owner-resident), all_gather
@@ -186,8 +193,17 @@ class SeqConfig:
     pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
     spec: LMSpec = LMSpec()
 
+    def policy(self):
+        """The resolved precision policy (``ddl_tpu.precision.resolve``
+        over this config's precision/compute_dtype pair); every step
+        body brackets its gradient reduction with the policy's
+        cast/upcast hooks — Python-level no-ops off-path."""
+        from .. import precision as _precision
+
+        return _precision.resolve(self.precision, self.compute_dtype)
+
     def dtype(self):
-        return None if self.compute_dtype is None else jnp.dtype(self.compute_dtype)
+        return self.policy().compute_dtype
 
     def validate_topology(self) -> None:
         """Fail-fast pipeline topology validation (one place, unit-
@@ -438,8 +454,14 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
     ``psum_scatter`` performs the one and only cross-shard reduction.
     On the 2-D mesh the scatter runs over the COMBINED (dp, sp) axes:
     one collective both sums the dp/sp partial gradients and lands each
-    of the dp*sp devices its owned chunk."""
+    of the dp*sp devices its owned chunk.
+
+    Under ``precision="bf16"`` the policy casts the flat gradient to
+    bf16 BEFORE the scatter (halved collective bytes) and upcasts the
+    owned chunk at the Adam boundary (fp32 m/v/master — the arXiv
+    2204.06514 split); Python-level no-ops off-path."""
     attn = _attn_for(config, platform)
+    pol = config.policy()
     n_dev = config.data_parallel * config.num_workers
     chunk = coll.chunk_size(plan.total, n_dev)
 
@@ -448,8 +470,10 @@ def _zero1_step_body(config: SeqConfig, plan: _FlatPlan,
         l_local, grads = jax.value_and_grad(local_loss)(params)
         loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
         g_own = coll.reduce_scatter_flat(
-            plan.flatten(grads), n_dev, AXES, mean=False, chunk=chunk
+            plan.flatten(pol.cast_grads(grads)), n_dev, AXES, mean=False,
+            chunk=chunk,
         )
+        g_own = pol.upcast_grads(g_own)
         my_chunk = lax.axis_index(DP_AXIS) * config.num_workers \
             + lax.axis_index(SP_AXIS)  # lex order, = psum_scatter's split
         p_own = lax.dynamic_slice(
@@ -580,8 +604,13 @@ def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
       reduction doesn't exist — each device owns its shard outright),
       then the SAME TF1-Adam update the replicated path applies, on
       m/v that live sharded tp-fold with the weights.
+
+    Under ``precision="bf16"`` BOTH subtrees' reductions move bf16
+    bytes — the flat scatter and the per-leaf psums — and both upcast
+    at their Adam boundary (ddl_tpu.precision); no-ops off-path.
     """
     attn = _attn_for(config, platform)
+    pol = config.policy()
     n_dev = config.data_parallel * config.num_workers
     chunk = coll.chunk_size(hplan.rep_total, n_dev)
 
@@ -589,13 +618,14 @@ def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
         local_loss = _local_loss_fn(config, attn, tokens, targets, weights)
         l_local, grads = jax.value_and_grad(local_loss)(params)
         loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
-        g_rep, g_tp = hplan.split(grads)
+        g_rep, g_tp = hplan.split(pol.cast_grads(grads))
         p_rep, p_tp = hplan.split(params)
 
         # Replicated subtree: ZeRO-1 over the combined (dp, sp) axes.
         g_own = coll.reduce_scatter_flat(
             hplan.flatten_rep(g_rep), n_dev, AXES, mean=False, chunk=chunk
         )
+        g_own = pol.upcast_grads(g_own)
         my_chunk = lax.axis_index(DP_AXIS) * config.num_workers \
             + lax.axis_index(SP_AXIS)  # lex order, = psum_scatter's split
         p_own = lax.dynamic_slice(
@@ -610,7 +640,7 @@ def _zero1_tp_step_body(config: SeqConfig, hplan: _HybridPlan,
 
         # tp-sharded leaves: full (dp, sp) reduction, tp-local Adam with
         # the SHARED step counter (flat.step == opt.step + 1 already).
-        g_tp = [lax.psum(g, AXES) for g in g_tp]
+        g_tp = [pol.upcast_grads(lax.psum(g, AXES)) for g in g_tp]
         tp_new, tp_state = adam_update(
             p_tp, AdamState(step=opt.step, m=opt.m_tp, v=opt.v_tp), g_tp,
             lr=config.learning_rate,
@@ -699,14 +729,22 @@ def _step_body(config: SeqConfig, platform: str | None = None,
     computed on the FULLY-REDUCED grads — tp-sharded leaves' squared
     sums psum over tp per the param specs) as a fourth output; the flag
     is a Python-level branch, so ``health=False`` compiles the exact
-    pre-observability program."""
+    pre-observability program.
+
+    Under ``precision="bf16"`` the policy's cast/upcast hooks bracket
+    the psum — the wire moves bf16 gradient bytes, the optimizer sees
+    fp32 (ddl_tpu.precision); both hooks are Python-level no-ops for
+    fp32/legacy configs, which compile the exact pre-policy program."""
     attn = _attn_for(config, platform)
+    pol = config.policy()
 
     def step(params, opt_state, tokens, targets, weights):
         local_loss = _local_loss_fn(config, attn, tokens, targets, weights)
         l_local, grads = jax.value_and_grad(local_loss)(params)
         loss = lax.psum(l_local, AXES)  # global weighted mean, replicated
+        grads = pol.cast_grads(grads)
         grads = jax.tree.map(lambda g: lax.psum(g, AXES), grads)
+        grads = pol.upcast_grads(grads)
         new_params, new_opt = adam_update(
             params, opt_state, grads, lr=config.learning_rate
         )
@@ -1385,8 +1423,11 @@ class SeqTrainer:
                 cfg.spec, bs, ds.seq_len, remat=cfg.remat
             )
             n_dev = int(self.mesh.devices.size)
+            # Policy-aware denominator (ISSUE 19): an fp32 run anchors
+            # to the fp32 peak, not the table's bf16 row.
             peak = _cost.peak_flops_per_device(
-                self.mesh.devices.flat[0], peak_flops
+                self.mesh.devices.flat[0], peak_flops,
+                precision=cfg.policy().mfu_kind,
             )
             mem_sampler = MemorySampler(metrics, self.mesh.devices.flat)
 

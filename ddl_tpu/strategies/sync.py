@@ -116,8 +116,10 @@ def _adam_flat(p, state: ShardedAdam, g, *, lr, b1=0.9, b2=0.999, eps=1e-8,
 
 def _local_grads(config: TrainConfig, params, x, y, rng, axis: str):
     """Per-device loss+grads with a device-distinct dropout stream
-    (reference workers use independent masks — SURVEY.md §7d)."""
-    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+    (reference workers use independent masks — SURVEY.md §7d). The
+    compute dtype is the resolved precision policy's
+    (``TrainConfig.policy()`` — ddl_tpu.precision)."""
+    compute_dtype = config.policy().compute_dtype
     rng = jax.random.fold_in(rng, lax.axis_index(axis))
     loss, grads = jax.value_and_grad(cnn.loss_fn)(
         params,

@@ -57,6 +57,22 @@ class TrainConfig:
     # None = fp32 (reference parity); "bfloat16" engages the MXU fast path.
     compute_dtype: str | None = None
 
+    # Precision policy (ddl_tpu.precision): "fp32" (reference-parity
+    # programs, byte-identical to the default) or "bf16" (bf16
+    # activations/gradients, fp32 master weights + Adam moments —
+    # arXiv 2204.06514's split). None defers to the legacy
+    # compute_dtype thread above, so existing configs compile their
+    # pre-policy programs unchanged.
+    precision: str | None = None
+
+    def policy(self):
+        """The resolved precision policy — the one compute-dtype
+        authority every trainer reads (``precision.resolve`` rejects a
+        conflicting precision/compute_dtype pair loudly)."""
+        from .. import precision as _precision
+
+        return _precision.resolve(self.precision, self.compute_dtype)
+
     # Sharded update: use the hand-fused Pallas Adam kernel instead of the
     # XLA-fused elementwise chain (ops/pallas_adam.py; ~1-ulp-equivalent,
     # measured against XLA by benchmarks/adam_kernel.py).

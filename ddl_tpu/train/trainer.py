@@ -67,8 +67,15 @@ def make_train_step(
     (``resilience.guard.apply_guard`` — an in-graph select, no host
     sync) and appends the step's int32 skip flag as the LAST output.
     Both flags are Python-level branches, so the default program is
-    byte-identical to the pre-observability/pre-guard one."""
-    compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
+    byte-identical to the pre-observability/pre-guard one.
+
+    The compute dtype comes from the resolved precision policy
+    (``TrainConfig.policy()`` — ddl_tpu.precision): single-chip, so the
+    policy's whole lever is the in-loss cast (the cast's autodiff
+    transpose already upcasts the cotangents, so ``grads`` reach Adam
+    as fp32 leaves against fp32 master weights under every policy);
+    ``precision="fp32"``/None compiles the byte-identical program."""
+    compute_dtype = config.policy().compute_dtype
 
     def step(params, opt_state, x, y, rng):
         loss, grads = jax.value_and_grad(cnn.loss_fn)(
@@ -307,7 +314,7 @@ def staging_dtype(config: TrainConfig):
 
     return (
         ml_dtypes.bfloat16
-        if config.compute_dtype == "bfloat16" else np.float32
+        if config.policy().compute_dtype is not None else np.float32
     )
 
 
@@ -678,7 +685,11 @@ class SingleChipTrainer:
                 cfg.batch_size, cfg.conv_channels, cfg.fc_sizes
             )
             dev0 = jax.devices()[0]
-            peak = _cost.peak_flops_per_device(dev0, peak_flops)
+            # Policy-aware denominator (ISSUE 19): an fp32 run anchors
+            # to the fp32 peak, not the table's bf16 row.
+            peak = _cost.peak_flops_per_device(
+                dev0, peak_flops, precision=cfg.policy().mfu_kind
+            )
             mem_sampler = MemorySampler(metrics, [dev0])
 
         def fn_for(k: int):

@@ -369,6 +369,21 @@ def _read_tree(data, path, like: Any, prefix: str = "") -> Any:
                 f"checkpoint leaf {key} has shape {saved.shape}, "
                 f"expected {want}"
             )
+        # Dtype must match the template exactly (ISSUE 19): the
+        # precision-policy contract keeps master weights and Adam
+        # moments fp32 under EVERY policy, so a dtype disagreement
+        # means the save came from a different program (a hand-rolled
+        # half-precision export, a foreign trainer) — silently casting
+        # would launder it into a "loaded" state that trains
+        # differently. Fail loudly, naming the leaf.
+        want_dtype = getattr(leaf, "dtype", None)
+        if want_dtype is not None and saved.dtype != np.dtype(want_dtype):
+            raise ValueError(
+                f"checkpoint leaf {key} has dtype {saved.dtype}, "
+                f"expected {np.dtype(want_dtype)} — precision policies "
+                "keep master state fp32; re-export the checkpoint "
+                "rather than casting on load"
+            )
         leaves.append(saved)
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(like), leaves

@@ -41,7 +41,14 @@ Bounds (per test function, per run):
   sites (ISSUE 13: an autoscaled fleet can grow to its cap, and every
   scaled-out replica compiles its own program ladder — the cap ledger
   already subsumes the seed replicas, so the bound takes the LARGEST of
-  the three ledgers, not their sum). ``pytest.mark.parametrize`` cases
+  the three ledgers, not their sum), AND at least the PRODUCT of the
+  count of distinct literal ``precision=`` values and the count of
+  distinct literal ``kv_dtype=`` values across call sites (ISSUE 19:
+  every precision policy / KV dtype is its own compiled program ladder,
+  so an fp32-vs-bf16-vs-int8 A/B/C builds three engines even without a
+  ``for`` sweep — ``None`` literals count as a distinct value, and the
+  variant ledger competes in the same LARGEST-of-all-ledgers bound).
+  ``pytest.mark.parametrize`` cases
   are separate tier-1 tests and are deliberately NOT multiplied in.
 
 **Sim-only exemption (ISSUE 18)**: a test whose every engine is the
@@ -163,6 +170,8 @@ def estimate(fn) -> tuple[bool, int, int]:
     topologies = 1
     router_replicas = 0
     fleet_caps = 0
+    precisions: set = set()
+    kv_dtypes: set = set()
     for node in ast.walk(fn):
         if id(node) in skip:
             continue
@@ -198,6 +207,17 @@ def estimate(fn) -> tuple[bool, int, int]:
                 v = _const_int(kw.value)
                 if v is not None:
                     spec_k = max(spec_k, v)
+            elif kw.arg in ("precision", "kv_dtype") and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, (str, type(None))):
+                # ISSUE 19 extension: every DISTINCT literal precision
+                # policy / KV dtype compiles its own program ladder —
+                # an fp32-vs-int8 A/B is two engines even without a
+                # ``for`` sweep, so distinct values per axis multiply
+                # into the variant ledger below (None counts: it is
+                # the fp32/full-precision arm of such an A/B).
+                (precisions if kw.arg == "precision"
+                 else kv_dtypes).add(kw.value.value)
         name = _call_name(node)
         if name in ("Request", "dict"):
             # dict() covers the mixed-traffic class specs — their
@@ -235,8 +255,9 @@ def estimate(fn) -> tuple[bool, int, int]:
             if v is not None:
                 prompt_set = max(prompt_set, v)
     tokens = max(prompt_set, request_sites) * (max_new + spec_k)
+    variants = max(1, len(precisions)) * max(1, len(kv_dtypes))
     return uses_scheduler, tokens, max(topologies, router_replicas,
-                                       fleet_caps)
+                                       fleet_caps, variants)
 
 
 def _audit(tree) -> list[tuple[str, int, int]]:
@@ -601,6 +622,57 @@ def test_twin_audit_estimator_extension():
     assert sim_only(fns["test_million_request_twin"])
     assert not sim_only(fns["test_real_engine_keeps_teeth"])
     assert not sim_only(fns["test_unfactored_router_keeps_teeth"])
+
+
+def test_precision_kv_audit_estimator_extension():
+    """ISSUE 19 self-pin: distinct literal ``precision=`` values times
+    distinct literal ``kv_dtype=`` values form the variant ledger —
+    every precision policy / KV dtype compiles its own program ladder,
+    so a 2x2 precision-by-dtype matrix flags (4 engines) while a plain
+    fp32-vs-int8 A/B stays in budget (2), ``None`` literals count as
+    the full-precision arm, and non-literal values contribute nothing
+    (the documented lower-bound discipline)."""
+    src = textwrap.dedent("""
+        def test_precision_kv_matrix_overrun():
+            engines = [
+                make_engine(precision="fp32", kv_dtype=None),
+                make_engine(precision="fp32", kv_dtype="int8"),
+                make_engine(precision="bf16", kv_dtype=None),
+                make_engine(precision="bf16", kv_dtype="int8"),
+            ]
+            sched = Scheduler(engines)
+            sched.run([Request(id=0, prompt=p, max_new_tokens=4)])
+
+        def test_kv_dtype_ab_in_budget():
+            base = InferenceEngine(ServeConfig(page_size=8,
+                                               kv_dtype=None))
+            quant = InferenceEngine(ServeConfig(page_size=8,
+                                                kv_dtype="int8"))
+            reqs = [Request(id=0, prompt=p, max_new_tokens=8),
+                    Request(id=1, prompt=p, max_new_tokens=8)]
+            Scheduler(base).run(reqs)
+            Scheduler(quant).run(reqs)
+
+        def test_nonliteral_kv_exempt():
+            for kd in dtypes:
+                eng = InferenceEngine(ServeConfig(page_size=8,
+                                                  kv_dtype=kd))
+                Scheduler(eng).run([Request(id=0, prompt=p,
+                                            max_new_tokens=2)])
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_precision_kv_matrix_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_precision_kv_matrix_overrun"])
+    assert uses and tokens == 4 and topo == 4  # 2 precisions x 2 dtypes
+    uses, tokens, topo = estimate(fns["test_kv_dtype_ab_in_budget"])
+    assert uses and tokens == 16 and topo == 2  # None + "int8" arms
+    # kv_dtype bound to a variable resolves to nothing: the estimate is
+    # a lower bound, never a false positive on plain code — and the
+    # non-literal ``for`` iterable doesn't sweep the topology ledger.
+    uses, tokens, topo = estimate(fns["test_nonliteral_kv_exempt"])
+    assert uses and tokens == 2 and topo == 1
 
 
 def test_fault_injection_tests_carry_slow_marker():
