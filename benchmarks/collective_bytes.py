@@ -21,52 +21,16 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
-                "pred": 1, "s8": 1, "u8": 1}
-
-_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
-                "collective-permute")
-
-
-def collective_ops(hlo_text: str) -> list[dict]:
-    """Parse collective ops + result shapes out of optimized HLO text.
-
-    Handles tuple-shaped (fused) results — ``= (f32[5882], f32[])
-    all-reduce(...)`` counts EVERY member shape, so a fused full-vector
-    all-reduce can never hide behind a scalar sibling (the audit's whole
-    point is catching exactly that regression)."""
-    out = []
-    op_pat = re.compile(r"=\s*(.*?)\s(" + "|".join(_COLLECTIVES) + r")\(")
-    shape_pat = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-    for line in hlo_text.splitlines():
-        m = op_pat.search(line)
-        if not m:
-            continue
-        result_txt, op = m.group(1), m.group(2)
-        shapes = []
-        total_bytes = 0
-        for dtype, dims in shape_pat.findall(result_txt):
-            shape = [int(d) for d in dims.split(",") if d] if dims else []
-            elems = 1
-            for d in shape:
-                elems *= d
-            shapes.append({"dtype": dtype, "shape": shape,
-                           "elems": elems})
-            total_bytes += elems * _DTYPE_BYTES.get(dtype, 4)
-        out.append({
-            "op": op,
-            "dtype": shapes[0]["dtype"] if shapes else "?",
-            "shape": [s["shape"] for s in shapes] if len(shapes) > 1
-                     else (shapes[0]["shape"] if shapes else []),
-            "max_elems": max((s["elems"] for s in shapes), default=0),
-            "bytes": total_bytes,
-        })
-    return out
+# THE parser lives in the library now (ISSUE 20): the live ledger and
+# this offline audit read the same HLO through the same code, so the
+# two surfaces cannot drift. Re-exported here because the tool's
+# output schema predates the move.
+from ddl_tpu.obs.comms import collective_ops  # noqa: E402
 
 
 def audit_layout(policy: str, devices: int, tiny: bool = True) -> dict:
@@ -123,8 +87,16 @@ def _opt_bytes_per_device(opt_state) -> int:
     )
 
 
+def _timed_call(compiled, args) -> float:
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(compiled(*args))
+    return time.perf_counter() - t0
+
+
 def audit_lm(mode: str, dp: int, sp: int, tp: int = 1, pp: int = 1,
-             microbatches: int = 2) -> dict:
+             microbatches: int = 2, precision: str | None = None) -> dict:
     """Collective schedule of the LM train step (strategies/seq.py) on a
     ``[dp, sp(, tp)]`` mesh: ``replicated`` should show the grad
     all-reduce (plus the ring's collective-permutes); ``zero1`` should
@@ -165,25 +137,56 @@ def audit_lm(mode: str, dp: int, sp: int, tp: int = 1, pp: int = 1,
                   zero1=(mode == "zero1"), batch_size=nseq,
                   tensor_parallel=tp, pipeline_parallel=pp,
                   microbatches=microbatches if pp > 1 else 1,
-                  spec=TINY_SPEC),
+                  precision=precision, spec=TINY_SPEC),
         ds,
     )
     xs = tr.stage_batches(ds.tokens, 1, nseq)
     ys = tr.stage_batches(ds.targets, 1, nseq)
     ws = tr.stage_batches(ds.weights, 1, nseq)
-    txt = (tr.span_program(1)
-           .lower(tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0))
-           .compile().as_text())
-    ops = collective_ops(txt)
+    low = tr.span_program(1).lower(tr.params, tr.opt_state, xs, ys, ws,
+                                   jnp.int32(0))
+    # The AS-WRITTEN schedule (pre-optimization HLO): the bytes a
+    # bf16-honoring interconnect (TPU) moves. The CPU backend's
+    # optimizer folds bf16 collectives back to f32 (converts are free
+    # host-side), so only this text can show the precision policy's
+    # halved gradient wire — the optimized `collectives` below report
+    # what THIS backend actually compiled.
+    wire_ops = collective_ops(low.as_text(dialect="hlo"))
+    compiled = low.compile()
+    ops = collective_ops(compiled.as_text())
+    # Measured step time of the SAME compiled program (best of a few
+    # one-step dispatches after a warm call) — the observation side of
+    # the two-roofline falsification (obs.comms.fit_roofline): one
+    # (peak, bw) pair must explain every topology row at once.
+    import jax
+
+    args = (tr.params, tr.opt_state, xs, ys, ws, jnp.int32(0))
+    jax.block_until_ready(compiled(*args))
+    measured = min(
+        _timed_call(compiled, args) for _ in range(3)
+    )
+    from ddl_tpu.obs import cost as _cost
+
+    n_dev = dp * sp * tp * pp
     row = {
         "mode": mode,
         "mesh": (f"{dp}x{sp}x{tp}x{pp}" if pp > 1
                  else f"{dp}x{sp}" + (f"x{tp}" if tp > 1 else "")),
+        "devices": n_dev,
         "total_params": tr._plan.total,
         "opt_state_bytes_per_device": _opt_bytes_per_device(tr.opt_state),
         "collectives": ops,
         "reduce_bytes": sum(o["bytes"] for o in ops
                             if o["op"] in ("all-reduce", "reduce-scatter")),
+        "wire_reduce_bytes": sum(
+            o["bytes"] for o in wire_ops
+            if o["op"] in ("all-reduce", "reduce-scatter")
+            and o["max_elems"] > 1  # scalar loss/denominator psums out
+        ),
+        "precision": precision or "fp32",
+        "flops_per_step": _cost.lm_train_step_flops(TINY_SPEC, nseq, 8 * sp),
+        "comms_bytes_per_step": sum(o["bytes"] for o in ops),
+        "measured_step_s": measured,
     }
     if pp > 1:
         from ddl_tpu.pipeline.schedule import predicted_bubble
@@ -225,6 +228,11 @@ def main() -> int:
         audit_lm("zero1", 1, args.devices),
         audit_lm("zero1", 2, half),
         audit_lm("replicated", 1, half, tp=2),
+        # The bf16 twin of the first row: same mode, same mesh, only
+        # the precision policy differs — the fp32/bf16 gradient-
+        # collective byte ratio `analyze comms` reports (exactly 2.0,
+        # ISSUE 19's policy tied to ISSUE 20's ledger).
+        audit_lm("replicated", 1, args.devices, precision="bf16"),
     ]
     if args.devices >= 2:
         # The pipeline row: activation-sized collective-permutes (one
@@ -241,9 +249,11 @@ def main() -> int:
         lm_rows.append(audit_lm("replicated", 2, 2, tp=2))
         lm_rows.append(audit_lm("zero1", 2, 2, tp=2))
     for r in lm_rows:
-        print(f"[lm {r['mode']} {r['mesh']}] total={r['total_params']} "
+        print(f"[lm {r['mode']} {r['mesh']} {r['precision']}] "
+              f"total={r['total_params']} "
               f"reduce_bytes={r['reduce_bytes']} "
-              f"opt_bytes/dev={r['opt_state_bytes_per_device']}",
+              f"opt_bytes/dev={r['opt_state_bytes_per_device']} "
+              f"step={r['measured_step_s'] * 1e3:.1f}ms",
               file=sys.stderr)
         if "permute_bytes" in r:
             print(f"    pp activation-permute bytes={r['permute_bytes']} "
@@ -284,9 +294,23 @@ def main() -> int:
               f"rep-subtree m/v elems {rep_total} -> {chunk} "
               f"({memory_law['rep_subtree_elems_per_device']['factor']}x)",
               file=sys.stderr)
+    # Two-roofline falsification (obs.comms.fit_roofline): one
+    # (peak, bw) pair fitted across every lm topology row; the per-row
+    # relative errors are the evidence `analyze comms` renders.
+    from ddl_tpu.obs.comms import fit_roofline
+
+    fit = fit_roofline([
+        {"flops": r["flops_per_step"], "bytes": r["comms_bytes_per_step"],
+         "measured_s": r["measured_step_s"]}
+        for r in lm_rows
+    ])
+    if fit is not None:
+        print(f"[roofline fit] peak={fit['fitted_peak_flops']:.3g} FLOP/s "
+              f"bw={fit['fitted_bw_bytes_per_s']:.3g} B/s "
+              f"max_rel_err={fit['max_rel_err']:.2f}", file=sys.stderr)
     result = {"metric": "sharded_step_collective_bytes",
               "devices": args.devices, "layouts": rows, "lm": lm_rows,
-              "memory_law": memory_law}
+              "memory_law": memory_law, "roofline_fit": fit}
     print(json.dumps(result))
     if args.json_path:
         with open(args.json_path, "w") as f:

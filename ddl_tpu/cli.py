@@ -215,6 +215,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "device-kind table (TPU v2-v5 bf16 peaks; unknown "
                         "kinds and CPU fall back to a documented nominal "
                         "anchor so CPU runs still produce a number)")
+    p.add_argument("--ici-bw", type=float, default=None, metavar="BPS",
+                   help="per-device interconnect bytes/s for the comms "
+                        "roofline gauges (ddl_tpu.obs.comms): overrides the "
+                        "built-in device-kind table (TPU v2-v5 nominal ICI "
+                        "figures; unknown kinds and CPU fall back to a "
+                        "documented nominal anchor so CPU runs still "
+                        "produce a number)")
     p.add_argument("--anomaly-rules", default=None, metavar="SPEC",
                    help="streaming anomaly detection (ddl_tpu.obs.anomaly) "
                         "on the deterministic tick clock: ';'-joined "
@@ -1106,6 +1113,7 @@ def _run_lm(args) -> int:
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=injector,
             peak_flops=args.peak_flops,
+            ici_bw=args.ici_bw,
             anomaly_detector=detector,
         )
         if registry is not None:
@@ -2010,6 +2018,7 @@ def main(argv: list[str] | None = None) -> int:
             max_bad_steps=args.max_bad_steps or 0,
             fault_injector=_make_injector(args, "single"),
             peak_flops=args.peak_flops,
+            ici_bw=args.ici_bw,
             anomaly_detector=detector,
         )
     elif tracer is not None:

@@ -56,10 +56,26 @@ The goodput & time-attribution plane (ISSUE 11) adds three more:
   JSONL, plus a ``compare`` regression gate over two metrics
   artifacts (exit nonzero past a threshold).
 
+The communication plane (ISSUE 20) adds one more:
+
+- :mod:`ddl_tpu.obs.comms` — the collective-op HLO parser as a library
+  surface (``benchmarks/collective_bytes.py`` now imports it), the
+  per-program static collective ledger (``collective_bytes{kind=,
+  program=}`` / ``collective_axis_bytes{axis=}`` /
+  ``collective_ops_total``) published at the same build points
+  ``xla_compiles_total`` counts, the per-device-kind ICI bandwidth
+  table behind ``--ici-bw``, the two-roofline step-time model
+  (``comms_time_model_s`` / ``comms_fraction`` /
+  ``step_bound{bound=}`` next to ``train_mfu``) with its
+  ``fit_roofline`` falsification harness, and the host byte plane
+  (``handoff_bytes_total{path=}`` priced by ``serve.cache.
+  kv_row_bytes``). ``analyze comms`` renders either a metrics JSONL or
+  the bench artifact (README "Communication accounting").
+
 Everything is surfaced by ``cli.py`` via ``--metrics-out``,
 ``--metrics-interval``, ``--trace-dir``, ``--prom-port``,
-``--peak-flops``, ``--slo-rules`` and ``--anomaly-rules``
-(README "Observability").
+``--peak-flops``, ``--ici-bw``, ``--slo-rules`` and
+``--anomaly-rules`` (README "Observability").
 """
 
 from .registry import (  # noqa: F401

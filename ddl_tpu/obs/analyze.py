@@ -1,6 +1,7 @@
 """Offline trace/metrics analysis CLI (ISSUE 11 tentpole piece 3)::
 
     python -m ddl_tpu.obs.analyze report  TRACE.jsonl   [--json] [--top N]
+    python -m ddl_tpu.obs.analyze comms   ARTIFACT      [--json]
     python -m ddl_tpu.obs.analyze compare OLD NEW [--threshold F]
                                           [--keys SUBSTR ...]
                                           [--ignore SUBSTR ...] [--json]
@@ -27,6 +28,16 @@ offline:
   breakdowns, every ``anomaly`` event (signal, tick, z), and incident
   counts (guard skips/rollbacks, sheds, deadline evictions, SLO
   alerts).
+
+``comms`` (ISSUE 20) renders the communication story of either artifact
+shape: a ``benchmarks/collective_bytes.py`` JSON artifact (per-topology
+collective schedules, the two-roofline fit against measured step times,
+the fp32/bf16 gradient-collective byte ratio from precision-twin rows)
+or a ``--metrics-out`` JSONL (the live per-program collective ledger,
+per-mesh-axis bytes, roofline gauges and ``handoff_bytes_total`` paths
+from the LAST snapshot). Always exits 0 on well-formed input — the
+regression gating over these numbers is ``compare``'s job (CI runs both
+over the committed artifact).
 
 ``compare`` diffs two metrics artifacts — ``--metrics-out`` JSONL files
 (the LAST snapshot record) or plain-JSON benchmark artifacts
@@ -291,6 +302,198 @@ def _print_report(rep: dict) -> None:
                                         for k, v in sorted(hits.items())))
 
 
+# -- comms --------------------------------------------------------------------
+
+
+def _load_comms_doc(path: str):
+    """``("bench", doc)`` for a ``collective_bytes.py`` JSON artifact
+    (recognized by its ``lm`` row list), else ``("metrics",
+    metrics_list)`` for a ``--metrics-out`` JSONL's LAST snapshot."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and isinstance(doc.get("lm"), list):
+        return "bench", doc
+    if isinstance(doc, dict) and doc.get("record") in ("manifest",
+                                                       "snapshot"):
+        doc = None  # single-line JSONL — fall through to line scan
+    if doc is not None:
+        raise ValueError(
+            f"{path}: JSON document without an 'lm' benchmark section "
+            "(not a collective_bytes.py artifact)"
+        )
+    snapshot = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("record") == "snapshot":
+            snapshot = rec
+    if snapshot is None:
+        raise ValueError(
+            f"{path}: neither a collective_bytes.py artifact nor a "
+            "metrics JSONL with snapshot records"
+        )
+    return "metrics", snapshot["metrics"]
+
+
+def _bench_comms_report(doc: dict) -> dict:
+    """The comms story of a benchmark artifact: per-topology collective
+    schedules, the two-roofline fit (recomputed through
+    :func:`obs.comms.fit_roofline` when the artifact predates the
+    stored fit), and the fp32/bf16 gradient-collective byte ratio of
+    every precision-twin pair (same mode, same mesh)."""
+    from .comms import fit_roofline
+
+    rows = []
+    for r in doc.get("lm", []):
+        by_kind: dict[str, int] = {}
+        for o in r.get("collectives", []):
+            by_kind[o["op"]] = by_kind.get(o["op"], 0) + o["bytes"]
+        rows.append({
+            "mode": r.get("mode"), "mesh": r.get("mesh"),
+            "precision": r.get("precision", "fp32"),
+            "devices": r.get("devices"),
+            "by_kind": {k: by_kind[k] for k in sorted(by_kind)},
+            "reduce_bytes": r.get("reduce_bytes"),
+            "wire_reduce_bytes": r.get("wire_reduce_bytes"),
+            "comms_bytes_per_step": r.get("comms_bytes_per_step"),
+            "flops_per_step": r.get("flops_per_step"),
+            "measured_step_s": r.get("measured_step_s"),
+        })
+    fit = doc.get("roofline_fit")
+    if fit is None:
+        fit = fit_roofline([
+            {"flops": r["flops_per_step"],
+             "bytes": r["comms_bytes_per_step"],
+             "measured_s": r["measured_step_s"]}
+            for r in rows
+        ])
+    if fit is not None:
+        for i, r in enumerate(rows):
+            if i < len(fit.get("model_s", [])):
+                r["model_s"] = fit["model_s"][i]
+                r["rel_err"] = fit["rel_err"][i]
+                comms_s = ((r.get("comms_bytes_per_step") or 0)
+                           * fit["inv_bw_s_per_byte"])
+                compute_s = ((r.get("flops_per_step") or 0)
+                             * fit["inv_peak_s_per_flop"])
+                r["bound"] = "comms" if comms_s > compute_s else "compute"
+    twins = {}
+    for r in rows:
+        twins.setdefault((r["mode"], r["mesh"]), {})[r["precision"]] = r
+    ratios = []
+    for (mode, mesh), by_prec in sorted(twins.items()):
+        if "fp32" in by_prec and "bf16" in by_prec:
+            # Wire bytes (the as-written schedule) when the artifact
+            # carries them: the backend that compiled the artifact may
+            # fold bf16 collectives back to f32 (CPU does), so only the
+            # pre-optimization schedule can show the policy's ratio.
+            def _rb(row):
+                wb = row.get("wire_reduce_bytes")
+                return wb if wb is not None else row["reduce_bytes"]
+
+            a, b = _rb(by_prec["fp32"]), _rb(by_prec["bf16"])
+            ratios.append({
+                "mode": mode, "mesh": mesh,
+                "fp32_reduce_bytes": a,
+                "bf16_reduce_bytes": b,
+                "ratio": a / b if b else math.inf,
+            })
+    return {"source": "bench", "devices": doc.get("devices"),
+            "rows": rows, "roofline_fit": fit,
+            "precision_ratios": ratios}
+
+
+def _metrics_comms_report(metrics: list[dict]) -> dict:
+    """The comms story of a live-run snapshot: the per-program ledger
+    (``collective_bytes{kind=,program=}`` and friends), the roofline
+    gauges, and the host byte plane (``handoff_bytes_total{path=}``)."""
+    programs: dict[str, dict] = {}
+
+    def prog(labels):
+        return programs.setdefault(labels.get("program", "?"), {
+            "total_bytes": None, "by_kind": {}, "by_axis": {}, "ops": {},
+        })
+
+    roofline: dict[str, float] = {}
+    handoff: dict[str, float] = {}
+    for m in metrics:
+        name, labels = m["name"], m.get("labels", {})
+        value = m.get("value")
+        if name == "collective_bytes_total":
+            prog(labels)["total_bytes"] = value
+        elif name == "collective_bytes":
+            prog(labels)["by_kind"][labels.get("kind", "?")] = value
+        elif name == "collective_axis_bytes":
+            prog(labels)["by_axis"][labels.get("axis", "?")] = value
+        elif name == "collective_ops_total":
+            prog(labels)["ops"][labels.get("kind", "?")] = value
+        elif name == "handoff_bytes_total":
+            handoff[labels.get("path", "?")] = value
+        elif name in ("comms_bytes_per_step", "comms_time_model_s",
+                      "compute_time_model_s", "step_time_model_s",
+                      "comms_fraction"):
+            roofline[name] = value
+        elif name == "step_bound" and value:
+            roofline["bound"] = labels.get("bound", "?")
+    return {"source": "metrics",
+            "programs": {p: programs[p] for p in sorted(programs)},
+            "roofline": roofline, "handoff_bytes": handoff}
+
+
+def _print_comms_report(rep: dict) -> None:
+    if rep["source"] == "bench":
+        fit = rep.get("roofline_fit")
+        if fit:
+            _emit(f"roofline fit: peak {fit['fitted_peak_flops']:.3g} "
+                  f"FLOP/s, bw {fit['fitted_bw_bytes_per_s']:.3g} B/s, "
+                  f"max rel err {fit['max_rel_err']:.2f}")
+        for r in rep["rows"]:
+            head = (f"[{r['mode']} {r['mesh']} {r['precision']}] "
+                    f"{r['comms_bytes_per_step'] or 0} B/step")
+            if "model_s" in r:
+                head += (f"  measured {r['measured_step_s'] * 1e3:.1f}ms "
+                         f"model {r['model_s'] * 1e3:.1f}ms "
+                         f"(err {r['rel_err']:+.0%}, {r['bound']}-bound)")
+            _emit(head)
+            for k, b in r["by_kind"].items():
+                _emit(f"    {k:<18} {b} B")
+        for p in rep["precision_ratios"]:
+            _emit(f"precision twin [{p['mode']} {p['mesh']}]: "
+                  f"fp32/bf16 gradient-collective bytes "
+                  f"{p['fp32_reduce_bytes']}/{p['bf16_reduce_bytes']} "
+                  f"= {p['ratio']:.2f}x")
+        return
+    for name, row in rep["programs"].items():
+        _emit(f"program {name}: {row['total_bytes']} B")
+        for k, b in sorted(row["by_kind"].items()):
+            n = row["ops"].get(k)
+            _emit(f"    {k:<18} {b} B" + (f"  ({n:.0f} ops)"
+                                          if n is not None else ""))
+        axes = {a: b for a, b in sorted(row["by_axis"].items()) if b}
+        if axes:
+            _emit("    axes: " + ", ".join(f"{a}={b} B"
+                                           for a, b in axes.items()))
+    rl = rep["roofline"]
+    if rl:
+        parts = [f"{k}={rl[k]:.3g}" for k in
+                 ("comms_bytes_per_step", "compute_time_model_s",
+                  "comms_time_model_s", "step_time_model_s",
+                  "comms_fraction") if k in rl]
+        if "bound" in rl:
+            parts.append(f"bound={rl['bound']}")
+        _emit("roofline gauges: " + " ".join(parts))
+    if rep["handoff_bytes"]:
+        _emit("handoff bytes: " + ", ".join(
+            f"{path}={v:.0f}" for path, v in
+            sorted(rep["handoff_bytes"].items())))
+
+
 # -- compare ------------------------------------------------------------------
 
 
@@ -410,6 +613,11 @@ def main(argv=None) -> int:
     rp.add_argument("--top", type=int, default=5,
                     help="straggler rows to show (default 5)")
     rp.add_argument("--json", action="store_true")
+    mp = sub.add_parser("comms", help="communication story of a "
+                                      "collective_bytes.py artifact or a "
+                                      "--metrics-out JSONL")
+    mp.add_argument("artifact")
+    mp.add_argument("--json", action="store_true")
     cp = sub.add_parser("compare", help="diff two metrics artifacts; exit 1 "
                                         "past --threshold")
     cp.add_argument("old")
@@ -440,6 +648,22 @@ def main(argv=None) -> int:
             _emit(f"[obs.analyze] cannot analyze trace {args.trace}: "
                   f"{type(e).__name__}: {e}")
             return 2
+        return 0
+
+    if args.cmd == "comms":
+        try:
+            kind, payload = _load_comms_doc(args.artifact)
+            rep = (_bench_comms_report(payload) if kind == "bench"
+                   else _metrics_comms_report(payload))
+        except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                ValueError) as e:
+            _emit(f"[obs.analyze] cannot analyze comms artifact "
+                  f"{args.artifact}: {type(e).__name__}: {e}")
+            return 2
+        if args.json:
+            _emit(json.dumps(rep))
+        else:
+            _print_comms_report(rep)
         return 0
 
     if args.threshold <= 0:

@@ -516,6 +516,21 @@ class FleetController:
             # record the miss instead of killing nothing silently.
             self._event(t, "replica_crash", replica=k, missed=True)
             return
+        # Host byte plane (ISSUE 20): a crash moves no pages — the
+        # requeue debt is the resident KV the re-run must REBUILD.
+        # Sized from the block tables BEFORE abandon() zeroes them,
+        # via the kv_row_bytes oracle; paged engines only (contiguous
+        # slots hold no page table to size).
+        eng = r.scheds[k].engine
+        if eng.paged:
+            debt = sum(int(eng.table_len[s])
+                       for s, _req, _a in r.scheds[k].occupant_requests())
+            if debt and r.registry is not None:
+                r.registry.counter(
+                    "handoff_bytes_total",
+                    help="KV bytes moved through the host, by "
+                         "hand-off path",
+                ).inc(eng.handoff_bytes(debt), path="requeue")
         cdone, inflight, queued = r.scheds[k].abandon()
         done.update(cdone)
         inflight_ids = {q.id for q in inflight}
@@ -826,6 +841,17 @@ class FleetController:
             self._event(t, "preempt_move", req=int(victim.id),
                         src=src, dst=dst)
             self._count("preemptions_total")
+            if r.registry is not None:
+                # Fleet-level byte plane (ISSUE 20) on the ROUTER
+                # registry; the source scheduler counted the same move
+                # on its OWN registry inside preempt() — distinct
+                # registries, no double count.
+                r.registry.counter(
+                    "handoff_bytes_total",
+                    help="KV bytes moved through the host, by "
+                         "hand-off path",
+                ).inc(r.engines[src].handoff_bytes(
+                    int(pre.pos.shape[0])), path="preempt")
             return  # one preemption per tick — deterministic and gentle
 
     # -- reporting ----------------------------------------------------------

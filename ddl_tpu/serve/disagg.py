@@ -202,7 +202,7 @@ class DisaggCoordinator:
                     continue  # no room anywhere: stay held this tick
                 dst = min(ranked)[2]
                 t0 = time.perf_counter()
-                pre = sched.preempt(occ.id)
+                pre = sched.preempt(occ.id, path="disagg")
                 r.scheds[dst].adopt(pre)
                 dt = time.perf_counter() - t0
                 pages = int(pre.pos.shape[0])
@@ -222,6 +222,16 @@ class DisaggCoordinator:
                 if r.registry is not None:
                     r.registry.counter("handoff_total").inc()
                     r.registry.counter("handoff_pages_total").inc(pages)
+                    # Fleet-level byte plane (ISSUE 20) on the ROUTER
+                    # registry — the per-replica count above lives on
+                    # the source scheduler's own registry, so neither
+                    # double-counts the other.
+                    r.registry.counter(
+                        "handoff_bytes_total",
+                        help="KV bytes moved through the host, by "
+                             "hand-off path",
+                    ).inc(r.engines[src].handoff_bytes(pages),
+                          path="disagg")
 
     def publish(self) -> None:
         """Per-role live-replica gauges on the router registry — the

@@ -101,10 +101,53 @@ from .cache import (
     copy_slot_prefix,
     host_cache,
     host_paged_cache,
+    kv_row_bytes,
     paged_cache_specs,
     write_page,
 )
 from .prefix import PrefixIndex
+
+
+class _LedgeredProgram:
+    """First-call AOT capture of one cached serve program for the
+    collective ledger (ISSUE 20, obs.comms). Built ONLY when the
+    engine's ``ledger_hook`` is attached at build time — without it the
+    cache holds the bare jitted callable and the off path is unchanged
+    by construction.
+
+    Order matters: calling a jitted fn after a separate
+    ``lower().compile()`` compiles the program TWICE (the jit call
+    cache does not adopt an external AOT compile), so the wrapper
+    compiles once at the first real call's arguments, hands the
+    ``Compiled`` object to the hook (which fetches the optimized HLO
+    text and publishes the ledger), and dispatches every call —
+    including the first — through that same executable. ``Compiled``
+    honors the jit's donation and accepts the host scalars the call
+    sites pass, so the dispatch semantics are the jit's own. ``lower``
+    delegates to the underlying jitted fn (the AOT probes in tests
+    lower cached programs directly)."""
+
+    __slots__ = ("_engine", "_kind", "_key", "_jfn", "_compiled")
+
+    def __init__(self, engine, kind: str, key: int, jfn):
+        self._engine = engine
+        self._kind = kind
+        self._key = key
+        self._jfn = jfn
+        self._compiled = None
+
+    def lower(self, *args, **kwargs):
+        return self._jfn.lower(*args, **kwargs)
+
+    def __call__(self, *args):
+        c = self._compiled
+        if c is None:
+            c = self._jfn.lower(*args).compile()
+            hook = self._engine.ledger_hook
+            if hook is not None:
+                hook(self._kind, self._key, c)
+            self._compiled = c
+        return c(*args)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -376,6 +419,15 @@ class InferenceEngine:
         # scheduler attaches a registry-backed hook when telemetry is
         # on, so the off path is unchanged.
         self.compile_hook = None
+        # Collective-ledger hook (ISSUE 20, obs.comms): called as
+        # ``hook(kind, key, compiled)`` once per distinct program at
+        # its first real dispatch, with the AOT ``Compiled`` object
+        # (the only handle the optimized HLO text hangs off). None
+        # (the default) leaves every cached program a bare jitted
+        # callable — no wrapper, no HLO fetch, the off path unchanged
+        # by construction. The scheduler attaches it beside
+        # ``compile_hook`` when a registry is on.
+        self.ledger_hook = None
         # The width the LAST decode attended per slot (paged: the
         # page-count bucket's rows; contiguous: the fixed capacity) —
         # the paged-aware denominator of serve_flops_per_token.
@@ -408,6 +460,24 @@ class InferenceEngine:
         docstring for the hook contract)."""
         if self.compile_hook is not None:
             self.compile_hook(kind, key)
+
+    def _ledgered(self, kind: str, key: int, jfn):
+        """Wrap a freshly built jitted program for collective-ledger
+        capture when the hook is attached; identity otherwise (the off
+        path caches the bare jit — ``_LedgeredProgram`` docstring)."""
+        if self.ledger_hook is None:
+            return jfn
+        return _LedgeredProgram(self, kind, key, jfn)
+
+    def handoff_bytes(self, n_pages: int) -> int:
+        """Device bytes ``n_pages`` dumped/loaded pages represent,
+        priced by the ``serve.cache.kv_row_bytes`` oracle (int8 pools:
+        payloads + scale planes — the compressed wire size the
+        ``handoff_bytes_total{path=}`` counters publish)."""
+        dtype = np.dtype(self.config.compute_dtype or np.float32)
+        return int(n_pages) * self.page_size * kv_row_bytes(
+            self.config.spec, self.config.kv_dtype, dtype
+        )
 
     # -- state -------------------------------------------------------------
 
@@ -535,11 +605,14 @@ class InferenceEngine:
                 # along untouched — freed pages reset ONLY their pos
                 # rows (stale payloads/scales are invisible behind
                 # PAD_POS, exactly like the contiguous ring).
-                self._reset_pages_fn = jax.jit(
-                    lambda cache, pages: dataclasses.replace(
-                        cache, pos=cache.pos.at[pages].set(PAD_POS),
+                self._reset_pages_fn = self._ledgered(
+                    "pages_reset", 0,
+                    jax.jit(
+                        lambda cache, pages: dataclasses.replace(
+                            cache, pos=cache.pos.at[pages].set(PAD_POS),
+                        ),
+                        donate_argnums=donation_for(self.mesh, 0),
                     ),
-                    donate_argnums=donation_for(self.mesh, 0),
                 )
                 self._note_compile("pages_reset", 0)
             self.cache = self._reset_pages_fn(self.cache, jnp.asarray(ids))
@@ -674,7 +747,10 @@ class InferenceEngine:
             nxt = self._sample(last, request_id, base + length)
             return nxt, logits, cache
 
-        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        fn = self._ledgered(
+            "prefill", bucket,
+            jax.jit(run, donate_argnums=donation_for(self.mesh, 1)),
+        )
         self._prefill_fns[bucket] = fn
         self._note_compile("prefill", bucket)
         return fn
@@ -711,8 +787,9 @@ class InferenceEngine:
             nxt = jax.vmap(self._sample)(logits, request_ids, lengths + 1)
             return nxt, logits, cache
 
-        self._decode_fn = jax.jit(
-            run, donate_argnums=donation_for(self.mesh, 1)
+        self._decode_fn = self._ledgered(
+            "decode", 0,
+            jax.jit(run, donate_argnums=donation_for(self.mesh, 1)),
         )
         self._note_compile("decode", 0)
         return self._decode_fn
@@ -792,7 +869,10 @@ class InferenceEngine:
             nxt = self._sample(last, request_id, base + length)
             return nxt, logits, pool
 
-        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        fn = self._ledgered(
+            "prefill", bucket,
+            jax.jit(run, donate_argnums=donation_for(self.mesh, 1)),
+        )
         self._prefill_fns[bucket] = fn
         self._note_compile("prefill", bucket)
         return fn
@@ -835,7 +915,10 @@ class InferenceEngine:
             nxt = jax.vmap(self._sample)(logits, request_ids, lengths + 1)
             return nxt, logits, pool
 
-        fn = jax.jit(run, donate_argnums=donation_for(self.mesh, 1))
+        fn = self._ledgered(
+            "decode", pages,
+            jax.jit(run, donate_argnums=donation_for(self.mesh, 1)),
+        )
         self._decode_paged_fns[pages] = fn
         self._note_compile("decode", pages)
         return fn
@@ -858,8 +941,9 @@ class InferenceEngine:
             out_specs=self._pcspecs,
             check_vma=False,
         )
-        self._copy_page_fn = jax.jit(
-            shard, donate_argnums=donation_for(self.mesh, 0)
+        self._copy_page_fn = self._ledgered(
+            "prefix_copy", 0,
+            jax.jit(shard, donate_argnums=donation_for(self.mesh, 0)),
         )
         self._note_compile("prefix_copy", 0)
         return self._copy_page_fn
@@ -899,8 +983,9 @@ class InferenceEngine:
             out_specs=self._pcspecs,
             check_vma=False,
         )
-        self._write_page_fn = jax.jit(
-            shard, donate_argnums=donation_for(self.mesh, 0)
+        self._write_page_fn = self._ledgered(
+            "page_write", 0,
+            jax.jit(shard, donate_argnums=donation_for(self.mesh, 0)),
         )
         self._note_compile("page_write", 0)
         return self._write_page_fn
@@ -1068,9 +1153,13 @@ class InferenceEngine:
             out_specs=self._cspecs,
             check_vma=False,
         )
-        fn = jax.jit(
-            shard,
-            donate_argnums=donation_for(self.mesh, 0 if into_cache else 1),
+        fn = self._ledgered(
+            "prefix_copy", int(into_cache),
+            jax.jit(
+                shard,
+                donate_argnums=donation_for(self.mesh,
+                                            0 if into_cache else 1),
+            ),
         )
         if into_cache:
             self._copy_in = fn

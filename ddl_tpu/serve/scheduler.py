@@ -127,6 +127,7 @@ import time
 
 import numpy as np
 
+from ..obs import comms as _comms
 from ..obs import cost as _cost
 from ..obs.goodput import GoodputTracker
 from ..obs.memory import MemorySampler, record_compile
@@ -461,6 +462,19 @@ class Scheduler:
                 record_compile(registry, _sched.tracer, kind, key=key)
 
             engine.compile_hook = _on_build
+
+            def _on_ledger(kind, key, compiled):
+                # Static collective ledger (ISSUE 20): every distinct
+                # compiled program publishes its collective-op bytes
+                # once, labelled to join the xla_compiles_total kinds.
+                # Registry captured like _on_build — warmup compiles
+                # are the same programs the run will dispatch.
+                _comms.publish_program_ledger(
+                    registry, _comms.program_text(compiled),
+                    program=f"{kind}[{key}]", mesh=engine.mesh,
+                )
+
+            engine.ledger_hook = _on_ledger
         # Externally-driven run state (ISSUE 8): armed by begin(),
         # advanced by tick(), finalized by collect()/release(). run()
         # is sugar over the same four primitives.
@@ -857,7 +871,8 @@ class Scheduler:
 
     # -- cross-replica preemption (ISSUE 13) --------------------------------
 
-    def preempt(self, request_id: int) -> PreemptedRequest:
+    def preempt(self, request_id: int,
+                *, path: str = "preempt") -> PreemptedRequest:
         """Lift an ACTIVE (mid-decode) occupant out of the armed run for
         resumption on another scheduler (``adopt``): serialize its
         resident pages host-side, free its slot — pages decref (shared
@@ -867,7 +882,16 @@ class Scheduler:
         exactly once, on the adopting scheduler). Paged engines only:
         slot-independent refcounted pages are what make the hand-off a
         serialize/deserialize, not a recompute — the resumed tokens are
-        bit-identical by construction (pinned in tests/test_fleet.py)."""
+        bit-identical by construction (pinned in tests/test_fleet.py).
+
+        Host byte plane (ISSUE 20): the dumped pages' host traffic
+        lands in ``handoff_bytes_total{path=}`` via the engine's
+        ``kv_row_bytes`` oracle — counted ONCE per round trip, on this
+        (dump) side; ``adopt`` moves the same bytes back down and does
+        not count again, so a preempt→adopt round trip on one registry
+        reads exactly the oracle. ``path`` labels who asked: a direct
+        controller preemption ("preempt") or a disagg prefill→decode
+        transfer ("disagg")."""
         st = self._require_run()
         eng = self.engine
         if not eng.paged:
@@ -890,6 +914,11 @@ class Scheduler:
                 "only active occupants carry a resumable decode cursor"
             )
         k, v, pos = eng.dump_slot_pages(s)
+        if self.registry is not None:
+            self.registry.counter(
+                "handoff_bytes_total",
+                help="KV bytes moved through the host, by hand-off path",
+            ).inc(eng.handoff_bytes(int(pos.shape[0])), path=path)
         pre = PreemptedRequest(
             request=r,
             generated=list(st.generated[s]),

@@ -1277,6 +1277,7 @@ class SeqTrainer:
         fault_injector=None,
         checkpoint_keep: int = 2,
         peak_flops: float | None = None,
+        ici_bw: float | None = None,
         anomaly_detector=None,
     ) -> LMResult:
         """Same persistence/observability contract as every other trainer:
@@ -1414,7 +1415,14 @@ class SeqTrainer:
         # watermark sampler. All None/absent with metrics off — the
         # compiled programs never change (host-side arithmetic only).
         step_flops = n_dev = peak = mem_sampler = mfu_of = None
+        bw = _comms = None
+        # Per-program collective ledgers (ISSUE 20, obs.comms): the
+        # span programs' static collective bytes, captured once per
+        # compile for the comms roofline gauges below. Keyed by k —
+        # per-STEP bytes divide the span's total by its step count.
+        span_comm_bytes: dict[int, int] = {}
         if metrics is not None:
+            from ..obs import comms as _comms
             from ..obs import cost as _cost
             from ..obs.memory import MemorySampler, record_compile
 
@@ -1429,6 +1437,7 @@ class SeqTrainer:
                 self.mesh.devices.flat[0], peak_flops,
                 precision=cfg.policy().mfu_kind,
             )
+            bw = _comms.ici_bw_per_device(self.mesh.devices.flat[0], ici_bw)
             mem_sampler = MemorySampler(metrics, self.mesh.devices.flat)
 
         def fn_for(k: int):
@@ -1451,6 +1460,16 @@ class SeqTrainer:
                     record_compile(metrics, tracer, "train_span",
                                    t0=tc, t1=t1, k=k)
                     gp.add("compile", t1 - tc)
+                    # Static collective ledger (ISSUE 20, obs.comms):
+                    # the program's bytes-on-the-wire, published once
+                    # per distinct compile. Registry-gated like the
+                    # clock reads — with metrics off the HLO text is
+                    # never even fetched.
+                    led = _comms.publish_program_ledger(
+                        metrics, _comms.program_text(fns[k]),
+                        program=f"train_span[{k}]", mesh=self.mesh,
+                    )
+                    span_comm_bytes[k] = led["total_bytes"]
             return fns[k]
 
         t0 = time.perf_counter()
@@ -1463,6 +1482,10 @@ class SeqTrainer:
             te1 = time.perf_counter()
             record_compile(metrics, tracer, "eval", t0=te0, t1=te1)
             gp.add("compile", te1 - te0)
+            _comms.publish_program_ledger(
+                metrics, _comms.program_text(ev),
+                program="eval[0]", mesh=self.mesh,
+            )
 
         def _rollback():
             """Guard escalation: restore the newest VALID checkpoint at
@@ -1544,6 +1567,28 @@ class SeqTrainer:
                             mfu_val = mfu_of(step_flops * k, span_s,
                                              n_dev, peak)
                             metrics.gauge("train_mfu").set(mfu_val)
+                            # Comms roofline (ISSUE 20, obs.comms):
+                            # the span program's static per-step bytes
+                            # against the ICI bandwidth anchor, next
+                            # to the FLOPs-vs-peak MFU — which wall
+                            # the step leans on, live.
+                            cb = span_comm_bytes.get(k, 0) / k
+                            rl = _comms.roofline(step_flops, cb,
+                                                 n_dev, peak, bw)
+                            metrics.gauge("comms_bytes_per_step").set(cb)
+                            metrics.gauge("comms_time_model_s").set(
+                                rl["comms_time_model_s"])
+                            metrics.gauge("compute_time_model_s").set(
+                                rl["compute_time_model_s"])
+                            metrics.gauge("step_time_model_s").set(
+                                rl["step_time_model_s"])
+                            metrics.gauge("comms_fraction").set(
+                                rl["comms_fraction"])
+                            sb = metrics.gauge("step_bound")
+                            sb.set(float(rl["bound"] == "compute"),
+                                   bound="compute")
+                            sb.set(float(rl["bound"] == "comms"),
+                                   bound="comms")
                             # Attribution (ISSUE 11): compile carve-
                             # out + compute/stall split, shared with
                             # the single-chip trainer in ONE helper so

@@ -551,6 +551,7 @@ class SingleChipTrainer:
         fault_injector=None,
         checkpoint_keep: int = 2,
         peak_flops: float | None = None,
+        ici_bw: float | None = None,
         anomaly_detector=None,
     ) -> TrainResult:
         """``metrics``/``metrics_interval``/``metrics_writer``/``tracer``
@@ -675,7 +676,16 @@ class SingleChipTrainer:
         # counters. Host-side arithmetic only: the compiled programs
         # are untouched, and everything is absent with metrics off.
         step_flops = peak = mem_sampler = mfu_of = note_compile = None
+        bw = _comms = None
+        # Per-program collective ledgers (ISSUE 20, obs.comms): the
+        # single-chip trainer's spans carry no collectives, but the
+        # ledger publishes anyway (a 0-byte row proves the program was
+        # audited, and a future multi-chip CNN step can't slip by
+        # unmetered) and the roofline gauges keep the seq trainer's
+        # vocabulary.
+        span_comm_bytes: dict[int, int] = {}
         if metrics is not None:
+            from ..obs import comms as _comms
             from ..obs import cost as _cost
             from ..obs.memory import MemorySampler, record_compile
 
@@ -690,6 +700,7 @@ class SingleChipTrainer:
             peak = _cost.peak_flops_per_device(
                 dev0, peak_flops, precision=cfg.policy().mfu_kind
             )
+            bw = _comms.ici_bw_per_device(dev0, ici_bw)
             mem_sampler = MemorySampler(metrics, [dev0])
 
         def fn_for(k: int):
@@ -706,6 +717,14 @@ class SingleChipTrainer:
                     note_compile(metrics, tracer, "train_span",
                                  t0=tc, t1=t1, k=k)
                     gp.add("compile", t1 - tc)
+                    # Static collective ledger (ISSUE 20) — registry-
+                    # gated: with metrics off the HLO text is never
+                    # fetched.
+                    led = _comms.publish_program_ledger(
+                        metrics, _comms.program_text(fns[k]),
+                        program=f"train_span[{k}]",
+                    )
+                    span_comm_bytes[k] = led["total_bytes"]
             return fns[k]
 
         resume_epoch, resume_spans = resume_plan(
@@ -803,6 +822,27 @@ class SingleChipTrainer:
                             mfu_val = mfu_of(step_flops * k, span_s, 1,
                                              peak)
                             metrics.gauge("train_mfu").set(mfu_val)
+                            # Comms roofline (ISSUE 20): same gauge
+                            # vocabulary as the seq trainer; one chip
+                            # means 0 collective bytes and a compute-
+                            # bound verdict by construction.
+                            cb = span_comm_bytes.get(k, 0) / k
+                            rl = _comms.roofline(step_flops, cb, 1,
+                                                 peak, bw)
+                            metrics.gauge("comms_bytes_per_step").set(cb)
+                            metrics.gauge("comms_time_model_s").set(
+                                rl["comms_time_model_s"])
+                            metrics.gauge("compute_time_model_s").set(
+                                rl["compute_time_model_s"])
+                            metrics.gauge("step_time_model_s").set(
+                                rl["step_time_model_s"])
+                            metrics.gauge("comms_fraction").set(
+                                rl["comms_fraction"])
+                            sb = metrics.gauge("step_bound")
+                            sb.set(float(rl["bound"] == "compute"),
+                                   bound="compute")
+                            sb.set(float(rl["bound"] == "comms"),
+                                   bound="comms")
                             # Attribution (ISSUE 11): compile carve-
                             # out + compute/stall split, shared with
                             # the seq trainer in ONE helper so the

@@ -60,6 +60,16 @@ above don't measure its cost; such tests are exempt even at
 million-request scale. One real engine anywhere (or one unfactored
 router site, which would build real engines) keeps the teeth.
 
+**Comms-ledger extension (ISSUE 20)**: a ``program_text`` /
+``publish_program_ledger`` name anywhere marks the test as
+compile-driving (the collective-ledger recounts AOT-compile real
+multi-device programs — the same wall-clock class as a scheduler
+topology), and ``SeqTrainer`` joins the topology ledger: constructor
+sites SUM (each trainer compiles its own span/eval program pair) and
+literal tuple/list ``for`` sweeps whose bodies construct one MULTIPLY,
+exactly like the engine ctors. ``collective_ops`` alone is pure text
+parsing and deliberately does NOT mark.
+
 The estimate is a documented LOWER bound: unresolvable (non-literal)
 values contribute nothing, so the audit can miss creative obfuscation
 but can never false-positive on plain code. Pure AST — no jax import,
@@ -77,6 +87,7 @@ MAX_FAST_TOPOLOGIES = 2
 _PROMPT_SET_FNS = ("synthesize_prompts", "synthesize_shared_prefix_prompts",
                    "synthesize_longtail_prompts", "synthesize_mixed_traffic")
 _ENGINE_CTORS = ("ServeConfig", "InferenceEngine")
+_TRAIN_CTORS = ("SeqTrainer",)
 _ROUTER_CTORS = ("Router", "RouterConfig")
 _FLEET_CTORS = ("FleetController", "AutoscaleConfig")
 _SIM_NAMES = ("CostModelEngine", "sim_engine_factory")
@@ -170,6 +181,7 @@ def estimate(fn) -> tuple[bool, int, int]:
     topologies = 1
     router_replicas = 0
     fleet_caps = 0
+    trainer_sites = 0
     precisions: set = set()
     kv_dtypes: set = set()
     for node in ast.walk(fn):
@@ -178,19 +190,24 @@ def estimate(fn) -> tuple[bool, int, int]:
         if isinstance(node, ast.Name) and node.id in (
             "Scheduler", "Router", "SloMonitor", "AnomalyDetector",
             "GoodputTracker", "FleetController", "Autoscaler",
+            "publish_program_ledger", "program_text",
         ):
             # SloMonitor (ISSUE 10) / AnomalyDetector + GoodputTracker
             # (ISSUE 11) / FleetController + Autoscaler (ISSUE 13): the
             # SLO/anomaly/goodput/fleet tests drive schedulers and
             # routers through those surfaces — any of these names alone
             # marks the test as scheduler-driving, so the observability
-            # and fleet tests count into the same budgets.
+            # and fleet tests count into the same budgets. The comms
+            # ledger surfaces (ISSUE 20) mark too: a test recounting
+            # through program_text / publish_program_ledger is
+            # AOT-compiling real multi-device programs.
             uses_scheduler = True
         if isinstance(node, ast.For) and isinstance(
             node.iter, (ast.Tuple, ast.List)
         ):
             sweeps_engine = any(
-                isinstance(sub, ast.Call) and _call_name(sub) in _ENGINE_CTORS
+                isinstance(sub, ast.Call)
+                and _call_name(sub) in _ENGINE_CTORS + _TRAIN_CTORS
                 for stmt in node.body
                 for sub in ast.walk(stmt)
             )
@@ -238,6 +255,10 @@ def estimate(fn) -> tuple[bool, int, int]:
             v = _kw_int(node, "max_replicas")
             if v is not None:
                 fleet_caps += v
+        elif name in _TRAIN_CTORS:
+            # ISSUE 20: every constructed trainer compiles its own
+            # span/eval program pair — sites SUM like replicas.
+            trainer_sites += 1
         elif name == "synthesize_prompts":
             v = _kw_int(node, "num")
             if v is not None:
@@ -257,7 +278,8 @@ def estimate(fn) -> tuple[bool, int, int]:
     tokens = max(prompt_set, request_sites) * (max_new + spec_k)
     variants = max(1, len(precisions)) * max(1, len(kv_dtypes))
     return uses_scheduler, tokens, max(topologies, router_replicas,
-                                       fleet_caps, variants)
+                                       fleet_caps, variants,
+                                       trainer_sites)
 
 
 def _audit(tree) -> list[tuple[str, int, int]]:
@@ -673,6 +695,58 @@ def test_precision_kv_audit_estimator_extension():
     # non-literal ``for`` iterable doesn't sweep the topology ledger.
     uses, tokens, topo = estimate(fns["test_nonliteral_kv_exempt"])
     assert uses and tokens == 2 and topo == 1
+
+
+def test_comms_audit_estimator_extension():
+    """ISSUE 20 self-pin: a ``program_text`` /
+    ``publish_program_ledger`` name marks the test compile-driving,
+    ``SeqTrainer`` constructor sites SUM into the topology ledger and a
+    literal-tuple ``for`` sweep constructing one MULTIPLIES — so a
+    3-config ledger recount flags while the 1-trainer recount stays in
+    budget, and ``collective_ops`` alone (pure text parsing, no
+    compile) never marks even over a 4-way trainer sweep."""
+    src = textwrap.dedent("""
+        def test_ledger_sweep_overrun():
+            reg = MetricRegistry()
+            for cfg in (cfg_a, cfg_b, cfg_c):
+                tr = SeqTrainer(cfg, ds)
+                tr.train(log=nolog, metrics=reg)
+                publish_program_ledger(
+                    reg, program_text(span(tr)),
+                    program="train_span[1]")
+
+        def test_trainer_sites_overrun():
+            a = SeqTrainer(cfg_a, ds)
+            b = SeqTrainer(cfg_b, ds)
+            c = SeqTrainer(cfg_c, ds)
+            for tr in (a, b, c):
+                ops = collective_ops(program_text(span(tr)))
+
+        def test_recount_in_budget():
+            tr = SeqTrainer(cfg, ds)
+            tr.train(log=nolog, metrics=reg)
+            ops = collective_ops(program_text(span(tr)))
+
+        def test_parser_only_exempt():
+            for cfg in (cfg_a, cfg_b, cfg_c, cfg_d):
+                tr = SeqTrainer(cfg, ds)
+                ops = collective_ops(HLO)
+    """)
+    tree = ast.parse(src)
+    names = {v[0] for v in _audit(tree)}
+    assert names == {"test_ledger_sweep_overrun",
+                     "test_trainer_sites_overrun"}
+    fns = {f.name: f for f in tree.body if isinstance(f, ast.FunctionDef)}
+    uses, tokens, topo = estimate(fns["test_ledger_sweep_overrun"])
+    assert uses and tokens == 0 and topo == 3  # sweep multiplies
+    uses, tokens, topo = estimate(fns["test_trainer_sites_overrun"])
+    assert uses and topo == 3  # sites sum; the name-only loop doesn't
+    uses, tokens, topo = estimate(fns["test_recount_in_budget"])
+    assert uses and topo == 1
+    # collective_ops without program_text/publish_program_ledger is
+    # parsing, not compiling: no gate, however wide the trainer sweep.
+    uses, tokens, topo = estimate(fns["test_parser_only_exempt"])
+    assert not uses and topo == 4
 
 
 def test_fault_injection_tests_carry_slow_marker():
